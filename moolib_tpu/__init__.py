@@ -1,0 +1,88 @@
+"""moolib_tpu — a TPU-native distributed-RL framework.
+
+Re-creation of the capability surface of moolib (reference:
+py/moolib/__init__.py:2-45 exports Accumulator, AllReduce, Batcher, Broker,
+EnvPool, EnvRunner, EnvStepper, EnvStepperFuture, Future, Group, Queue, Rpc,
+RpcDeferredReturn, RpcError, create_uid, set_log_level, set_logging,
+set_max_threads) redesigned TPU-first:
+
+- device math is JAX/XLA (jit, shard_map over a ``jax.sharding.Mesh``);
+- gradient reduction inside a cohort rides ICI via ``lax.psum`` collectives
+  (reference's software tree allreduce, src/group.h:508-788, remains as the
+  *DCN-level* elastic collective between cohorts);
+- actor rollouts stage into HBM as ``jax.Array`` batches;
+- the host-side control/acting plane is a named-peer RPC layer with broker
+  membership, mirroring the reference's L3-L5 design.
+
+Imports are lazy so that control-plane-only processes (e.g. the broker CLI)
+never pay for JAX/XLA initialization.
+"""
+
+from __future__ import annotations
+
+import importlib
+import secrets
+
+__version__ = "0.1.0"
+
+_EXPORTS = {
+    # RPC / control plane
+    "Rpc": "moolib_tpu.rpc",
+    "RpcError": "moolib_tpu.rpc",
+    "RpcDeferredReturn": "moolib_tpu.rpc",
+    "Future": "moolib_tpu.rpc",
+    "Queue": "moolib_tpu.rpc",
+    "Broker": "moolib_tpu.rpc",
+    "Group": "moolib_tpu.rpc",
+    "AllReduce": "moolib_tpu.rpc",
+    # training services
+    "Accumulator": "moolib_tpu.parallel",
+    # env execution & batching
+    "EnvPool": "moolib_tpu.envpool",
+    "EnvStepper": "moolib_tpu.envpool",
+    "EnvStepperFuture": "moolib_tpu.envpool",
+    "Batcher": "moolib_tpu.ops",
+    # utils
+    "set_log_level": "moolib_tpu.utils",
+    "set_logging": "moolib_tpu.utils",
+}
+
+__all__ = sorted(_EXPORTS) + ["create_uid", "set_max_threads", "__version__"]
+
+
+def create_uid() -> str:
+    """Random unique peer-name suffix (reference: src/moolib.cc create_uid)."""
+    return secrets.token_hex(16)
+
+
+_max_threads: int | None = None
+
+
+def set_max_threads(n: int) -> None:
+    """Cap worker threads used by the host runtime.
+
+    The reference caps its global C++ scheduler pool
+    (reference: src/moolib.cc:1573-1579 set_max_threads over src/async.h).
+    Here it bounds the RPC executor / batcher thread pools.
+    """
+    global _max_threads
+    if n <= 0:
+        raise ValueError("set_max_threads requires n >= 1")
+    _max_threads = n
+
+
+def get_max_threads() -> int | None:
+    return _max_threads
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'moolib_tpu' has no attribute {name!r}")
+    try:
+        return getattr(importlib.import_module(mod), name)
+    except (ImportError, AttributeError) as e:
+        raise AttributeError(
+            f"moolib_tpu.{name} is declared but its implementation in "
+            f"{mod} is unavailable: {e}"
+        ) from e

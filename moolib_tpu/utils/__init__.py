@@ -1,0 +1,28 @@
+"""Utility layer. ``nest`` is imported lazily because it pulls in jax, and
+control-plane-only processes (broker CLI, actors without a local model) must
+not pay JAX initialization cost (see moolib_tpu/__init__.py)."""
+
+import importlib
+
+from .logging import get_logger, set_log_level, set_logging
+from .stats import StatMax, StatMean, StatSum, Stats
+from .timer import Ewma, Timer
+
+__all__ = [
+    "nest",
+    "get_logger",
+    "set_log_level",
+    "set_logging",
+    "StatMax",
+    "StatMean",
+    "StatSum",
+    "Stats",
+    "Ewma",
+    "Timer",
+]
+
+
+def __getattr__(name: str):
+    if name == "nest":
+        return importlib.import_module("moolib_tpu.utils.nest")
+    raise AttributeError(f"module 'moolib_tpu.utils' has no attribute {name!r}")

@@ -1,0 +1,43 @@
+"""Leveled logging for the framework.
+
+Capability parity with the reference's C++ logger bridged into Python logging
+(reference: src/logging.h:26-106, set_logging/set_log_level at
+src/moolib.cc:1552-1565). Here the whole runtime is Python-visible so we route
+straight through the stdlib ``logging`` module under one namespace and expose
+the same two knobs.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_LOGGER = logging.getLogger("moolib_tpu")
+
+_LEVELS = {
+    "none": logging.CRITICAL + 10,
+    "error": logging.ERROR,
+    "info": logging.INFO,
+    "verbose": logging.DEBUG,
+    "debug": logging.DEBUG,
+}
+
+
+def get_logger(name: str | None = None) -> logging.Logger:
+    return _LOGGER.getChild(name) if name else _LOGGER
+
+
+def set_log_level(level: str) -> None:
+    """Set framework log level by name (none/error/info/verbose/debug)."""
+    if level not in _LEVELS:
+        raise ValueError(f"unknown log level {level!r}; one of {sorted(_LEVELS)}")
+    _LOGGER.setLevel(_LEVELS[level])
+
+
+def set_logging(enabled: bool = True) -> None:
+    """Enable/disable emitting framework logs to the root handlers."""
+    _LOGGER.propagate = bool(enabled)
+    if enabled and not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+        )

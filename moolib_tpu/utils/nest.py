@@ -1,0 +1,125 @@
+"""Nested-structure utilities over dict/list/tuple trees of arrays.
+
+Capability parity with the reference's nest helpers
+(reference: examples/common/nest.py usage in examples/common/__init__.py and
+src/batch_utils.{h,cc} stackFields/unstackFields/squeezeFields/unsqueezeFields),
+re-expressed on top of jax.tree_util so the same structures flow through jitted
+functions unchanged. All functions treat dicts, lists and tuples as interior
+nodes and everything else as leaves.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import jax
+import numpy as np
+
+__all__ = [
+    "map_structure",
+    "flatten",
+    "unflatten_as",
+    "zip_structures",
+    "stack_fields",
+    "unstack_fields",
+    "cat_fields",
+    "squeeze_fields",
+    "unsqueeze_fields",
+    "slice_fields",
+]
+
+
+def map_structure(fn: Callable, *trees: Any) -> Any:
+    """Apply ``fn`` leaf-wise over one or more trees with identical structure."""
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+def flatten(tree: Any) -> list:
+    return jax.tree_util.tree_leaves(tree)
+
+
+def unflatten_as(structure: Any, leaves: Iterable) -> Any:
+    treedef = jax.tree_util.tree_structure(structure)
+    return jax.tree_util.tree_unflatten(treedef, list(leaves))
+
+
+def zip_structures(*trees: Any) -> Any:
+    """Zip N same-shaped trees into one tree whose leaves are tuples."""
+    return jax.tree_util.tree_map(lambda *xs: tuple(xs), *trees)
+
+
+def _xp(leaf):
+    return jax.numpy if isinstance(leaf, jax.Array) else np
+
+
+def stack_fields(trees: Iterable[Any], axis: int = 0) -> Any:
+    """Stack a sequence of same-structure trees into one tree of batched leaves.
+
+    Equivalent capability to the reference's ``stackFields``
+    (reference: src/batch_utils.cc), used for request auto-batching.
+    """
+    trees = list(trees)
+    if not trees:
+        raise ValueError("stack_fields requires at least one tree")
+    return jax.tree_util.tree_map(
+        lambda *xs: _xp(xs[0]).stack(xs, axis=axis), *trees
+    )
+
+
+def cat_fields(trees: Iterable[Any], axis: int = 0) -> Any:
+    trees = list(trees)
+    if not trees:
+        raise ValueError("cat_fields requires at least one tree")
+    return jax.tree_util.tree_map(
+        lambda *xs: _xp(xs[0]).concatenate(xs, axis=axis), *trees
+    )
+
+
+def unstack_fields(tree: Any, batch_size: int | None = None, axis: int = 0) -> list:
+    """Split a batched tree back into its unbatched trees.
+
+    Inverse of :func:`stack_fields` (reference: src/batch_utils.cc
+    unstackFields). The count is derived from the leaves' ``axis`` length;
+    passing ``batch_size`` asserts it matches.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        raise ValueError("unstack_fields requires a tree with leaves")
+    n = leaves[0].shape[axis]
+    for leaf in leaves:
+        if leaf.shape[axis] != n:
+            raise ValueError(
+                f"inconsistent batch axis: {leaf.shape[axis]} != {n}"
+            )
+    if batch_size is not None and batch_size != n:
+        raise ValueError(f"batch_size {batch_size} != leaf axis length {n}")
+    # One pass per leaf: split each into n slices, then transpose into trees.
+    split = [
+        [_xp(x).squeeze(piece, axis=axis) for piece in _xp(x).split(x, n, axis=axis)]
+        for x in leaves
+    ]
+    return [
+        jax.tree_util.tree_unflatten(treedef, [s[i] for s in split])
+        for i in range(n)
+    ]
+
+
+def squeeze_fields(tree: Any, axis: int = 0) -> Any:
+    return jax.tree_util.tree_map(lambda x: _xp(x).squeeze(x, axis=axis), tree)
+
+
+def unsqueeze_fields(tree: Any, axis: int = 0) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: _xp(x).expand_dims(x, axis=axis), tree
+    )
+
+
+def slice_fields(tree: Any, start: int, stop: int, axis: int = 0) -> Any:
+    """Slice every leaf along ``axis`` (used by cat-batcher overflow splitting)."""
+
+    def _sl(x):
+        index = [slice(None)] * x.ndim
+        index[axis] = slice(start, stop)
+        return x[tuple(index)]
+
+    return jax.tree_util.tree_map(_sl, tree)

@@ -1,0 +1,42 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference CI runs CPU-only with per-test process isolation
+(reference: .github/workflows/run_python_tests.yml:33-50). We instead make the
+whole suite runnable on any host by forcing the JAX CPU backend with 8 virtual
+devices, so every multi-chip sharding test (dp/tp/sp meshes, psum collectives)
+executes for real without TPU hardware. Environment variables must be set
+before jax initializes its backends, hence module scope here.
+"""
+
+import os
+import sys
+
+# XLA_FLAGS is read when the backend initializes (lazily), so setting it here
+# is safe even if some pytest plugin already imported jax — as long as no
+# backend has been created yet.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# Belt and braces: jax.config wins even if jax was imported before us.
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8, (
+    "jax backend initialized before conftest.py could configure the virtual "
+    f"CPU mesh (got {jax.devices()})"
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(0)

@@ -1,0 +1,64 @@
+import numpy as np
+import pytest
+
+from moolib_tpu.utils import nest
+
+
+def _tree(rng, shape=(3, 4)):
+    return {
+        "obs": rng.standard_normal(shape).astype(np.float32),
+        "state": (
+            rng.standard_normal(shape).astype(np.float32),
+            rng.integers(0, 10, shape).astype(np.int32),
+        ),
+        "done": [rng.integers(0, 2, shape).astype(bool)],
+    }
+
+
+def test_stack_unstack_roundtrip(rng):
+    trees = [_tree(rng) for _ in range(5)]
+    stacked = nest.stack_fields(trees)
+    assert stacked["obs"].shape == (5, 3, 4)
+    back = nest.unstack_fields(stacked, 5)
+    for a, b in zip(trees, back):
+        for la, lb in zip(nest.flatten(a), nest.flatten(b)):
+            np.testing.assert_array_equal(la, lb)
+
+
+def test_cat_and_slice(rng):
+    trees = [_tree(rng, (2, 4)) for _ in range(3)]
+    cat = nest.cat_fields(trees)
+    assert cat["obs"].shape == (6, 4)
+    part = nest.slice_fields(cat, 2, 4)
+    np.testing.assert_array_equal(part["obs"], trees[1]["obs"])
+
+
+def test_squeeze_unsqueeze(rng):
+    t = _tree(rng)
+    up = nest.unsqueeze_fields(t)
+    assert up["obs"].shape == (1, 3, 4)
+    down = nest.squeeze_fields(up)
+    np.testing.assert_array_equal(down["obs"], t["obs"])
+
+
+def test_unflatten_as_and_zip(rng):
+    t = _tree(rng)
+    leaves = nest.flatten(t)
+    rebuilt = nest.unflatten_as(t, leaves)
+    for la, lb in zip(nest.flatten(rebuilt), leaves):
+        np.testing.assert_array_equal(la, lb)
+    z = nest.zip_structures(t, t)
+    assert isinstance(z["obs"], tuple) and len(z["obs"]) == 2
+
+
+def test_stack_empty_raises():
+    with pytest.raises(ValueError):
+        nest.stack_fields([])
+
+
+def test_jax_leaves_supported(rng):
+    import jax.numpy as jnp
+
+    trees = [{"a": jnp.arange(4.0)} for _ in range(3)]
+    out = nest.stack_fields(trees)
+    assert out["a"].shape == (3, 4)

@@ -1,0 +1,51 @@
+import math
+
+from moolib_tpu.utils import Ewma, StatMax, StatMean, Stats, StatSum
+
+
+def test_stat_mean():
+    s = StatMean()
+    s += 1.0
+    s += 3.0
+    assert s.result() == 2.0
+    s.reset()
+    assert math.isnan(s.result())
+
+
+def test_stat_mean_cumulative_and_merge():
+    s = StatMean(cumulative=True)
+    s += 2.0
+    s.reset()
+    assert s.result() == 2.0
+    other = StatMean()
+    d = s.diff(other)
+    other.merge(d)
+    assert other.result() == 2.0
+
+
+def test_stat_sum_and_max():
+    s = StatSum()
+    s += 5
+    s += 7
+    s.reset()
+    assert s.result() == 12
+    m = StatMax()
+    m += 3
+    m += 1
+    assert m.result() == 3
+
+
+def test_stats_dict():
+    st = Stats(loss=StatMean(), steps=StatSum())
+    st["loss"] += 4.0
+    st["steps"] += 128
+    r = st.results()
+    assert r["loss"] == 4.0 and r["steps"] == 128
+
+
+def test_ewma_bias_correction():
+    e = Ewma(alpha=0.5)
+    e.add(10.0)
+    assert abs(e.value - 10.0) < 1e-9
+    e.add(20.0)
+    assert 10.0 < e.value < 20.0
