@@ -1,0 +1,4 @@
+from . import vtrace
+from .batcher import Batcher
+
+__all__ = ["vtrace", "Batcher"]

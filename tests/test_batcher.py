@@ -1,0 +1,95 @@
+"""Randomized property tests of the Batcher against numpy stack/cat oracles
+(reference test strategy: test/unit/test_batcher.py:14-53 compares against
+torch.stack/torch.cat including cat overflow)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from moolib_tpu.ops import Batcher
+
+
+def _item(rng, shape=(3,)):
+    return {
+        "obs": rng.standard_normal(shape).astype(np.float32),
+        "aux": (rng.integers(0, 5, shape).astype(np.int64),),
+    }
+
+
+def test_stack_batches_match_oracle(rng):
+    bs = 4
+    b = Batcher(batch_size=bs)
+    items = [_item(rng) for _ in range(bs * 3 + 2)]
+    for it in items:
+        b.stack(it)
+    for k in range(3):
+        batch = b.get(timeout=1)
+        chunk = items[k * bs : (k + 1) * bs]
+        np.testing.assert_array_equal(
+            batch["obs"], np.stack([c["obs"] for c in chunk])
+        )
+        np.testing.assert_array_equal(
+            batch["aux"][0], np.stack([c["aux"][0] for c in chunk])
+        )
+    assert b.empty()  # 2 leftover items don't form a full batch
+
+
+def test_cat_overflow_splitting(rng):
+    bs = 8
+    b = Batcher(batch_size=bs)
+    sizes = [3, 7, 2, 9, 11, 1, 5]  # sums to 38 -> 4 full batches + 6 left
+    chunks = [_item(rng, (n, 2)) for n in sizes]
+    for c in chunks:
+        b.cat(c)
+    all_obs = np.concatenate([c["obs"] for c in chunks])
+    got = []
+    while not b.empty():
+        got.append(b.get(timeout=1)["obs"])
+    assert len(got) == 38 // bs
+    for i, g in enumerate(got):
+        assert g.shape[0] == bs
+        np.testing.assert_array_equal(g, all_obs[i * bs : (i + 1) * bs])
+
+
+def test_get_blocks_until_producer(rng):
+    b = Batcher(batch_size=2)
+    result = {}
+
+    def consumer():
+        result["batch"] = b.get(timeout=5)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    b.stack(_item(rng))
+    b.stack(_item(rng))
+    t.join(timeout=5)
+    assert not t.is_alive() and result["batch"]["obs"].shape == (2, 3)
+
+
+def test_timeout_and_close(rng):
+    b = Batcher(batch_size=2)
+    with pytest.raises(TimeoutError):
+        b.get(timeout=0.05)
+    b.close()
+    with pytest.raises(RuntimeError):
+        b.get(timeout=1)
+    with pytest.raises(RuntimeError):
+        b.stack(_item(rng))
+
+
+def test_device_placement(rng):
+    import jax
+
+    dev = jax.devices()[1]
+    b = Batcher(batch_size=2, device=dev)
+    b.stack(_item(rng))
+    b.stack(_item(rng))
+    batch = b.get(timeout=1)
+    assert isinstance(batch["obs"], jax.Array)
+    assert batch["obs"].devices() == {dev}
+
+
+def test_bad_batch_size():
+    with pytest.raises(ValueError):
+        Batcher(batch_size=0)
