@@ -1,0 +1,45 @@
+"""A2C policy/value network for vector observations.
+
+Capability parity with the reference's single-file A2C model
+(reference: examples/a2c.py:47-83 — obs MLP, optional LSTM, policy + baseline
+heads). Time-major [T, B, obs] in, ([T, B, A] logits, [T, B] baseline) out.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .core import FeedForwardCore, LSTMCore
+
+__all__ = ["A2CNet"]
+
+
+class A2CNet(nn.Module):
+    num_actions: int
+    hidden_sizes: Sequence[int] = (128, 128)
+    use_lstm: bool = False
+    lstm_size: int = 128
+
+    @nn.compact
+    def __call__(self, obs, done, core_state):
+        # obs: [T, B, F] float; done: [T, B] bool.
+        x = obs.astype(jnp.float32)
+        for h in self.hidden_sizes:
+            x = nn.relu(nn.Dense(h)(x))
+        if self.use_lstm:
+            x, core_state = LSTMCore(hidden_size=self.lstm_size)(
+                x, done, core_state
+            )
+        policy_logits = nn.Dense(self.num_actions)(x)
+        baseline = nn.Dense(1)(x).squeeze(-1)
+        return (policy_logits, baseline), core_state
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        if self.use_lstm:
+            z = jnp.zeros((batch_size, self.lstm_size), jnp.float32)
+            return (z, z)
+        return ()

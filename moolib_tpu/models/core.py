@@ -1,0 +1,77 @@
+"""Recurrent-core utilities shared by the agent models.
+
+The reference's models (reference: examples/atari/models.py:94-143,
+examples/a2c.py:47-83) are torch nn.Modules with hand-rolled Python time
+loops over an LSTM core that is reset where ``done`` is set. TPU-native
+version: the unroll is an ``nn.scan`` (lax.scan under the hood) over the time
+axis, with per-step state resets expressed as a masked multiply — static
+shapes, no Python loops, the whole unroll fuses into one XLA computation.
+
+All agent models in this package share one calling convention:
+
+    (logits_TBA, baseline_TB), new_state = model.apply(
+        params, obs_TBx, done_TB, core_state)
+
+Inputs are time-major [T, B, ...]; ``core_state`` is a (possibly empty) tuple
+of [B, ...] arrays so it round-trips through batchers and RPC unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["LSTMCore", "FeedForwardCore"]
+
+
+class FeedForwardCore(nn.Module):
+    """Identity core: no recurrence, empty state tuple."""
+
+    @nn.compact
+    def __call__(self, x, done, state):
+        return x, state
+
+    @staticmethod
+    def initial_state(batch_size: int) -> Tuple:
+        return ()
+
+
+class _MaskedLSTMStep(nn.Module):
+    """One LSTM step with done-masked state reset (scanned over time)."""
+
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, carry, xs):
+        xt, dt = xs
+        c, h = carry
+        mask = (~dt)[:, None].astype(xt.dtype)
+        carry, out = nn.OptimizedLSTMCell(features=self.hidden_size)(
+            (c * mask, h * mask), xt
+        )
+        return carry, out
+
+
+class LSTMCore(nn.Module):
+    """LSTM over time-major [T, B, F] input with per-step episode resets."""
+
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, x, done, state):
+        scan = nn.scan(
+            _MaskedLSTMStep,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )
+        carry, outs = scan(hidden_size=self.hidden_size)(state, (x, done))
+        return outs, carry
+
+    def initial_state(self, batch_size: int) -> Tuple[jax.Array, jax.Array]:
+        z = jnp.zeros((batch_size, self.hidden_size), jnp.float32)
+        return (z, z)

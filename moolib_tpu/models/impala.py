@@ -1,0 +1,86 @@
+"""IMPALA deep ResNet agent (15 conv layers) for pixel observations.
+
+Capability parity with the reference's IMPALA-deep torso
+(reference: examples/atari/models.py:16-143 — 3 sections of
+[conv, maxpool, 2 residual blocks] at 16/32/32 channels, FC-256, optional
+LSTM, policy + baseline heads; the architecture originates in the IMPALA
+paper, Espeholt et al. 2018).
+
+TPU-first choices: NHWC layout (the reference uses torch NCHW) so convs map
+directly onto the MXU's preferred dimension ordering, optional bfloat16
+compute with float32 params, and a scanned LSTM core instead of a Python time
+loop. Frames arrive uint8 [T, B, H, W, C]; normalization happens on-device.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .core import LSTMCore
+
+__all__ = ["ImpalaNet", "ResidualBlock", "ConvSequence"]
+
+
+class ResidualBlock(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.relu(x)
+        y = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=self.dtype)(y)
+        return x + y
+
+
+class ConvSequence(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = ResidualBlock(self.channels, dtype=self.dtype)(x)
+        x = ResidualBlock(self.channels, dtype=self.dtype)(x)
+        return x
+
+
+class ImpalaNet(nn.Module):
+    num_actions: int
+    channels: Sequence[int] = (16, 32, 32)
+    hidden_size: int = 256
+    use_lstm: bool = False
+    lstm_size: int = 256
+    compute_dtype: jnp.dtype = jnp.float32  # set jnp.bfloat16 on TPU
+
+    @nn.compact
+    def __call__(self, obs, done, core_state):
+        # obs: [T, B, H, W, C] uint8; done: [T, B] bool.
+        T, B = obs.shape[:2]
+        x = obs.astype(self.compute_dtype) / 255.0
+        x = x.reshape((T * B,) + obs.shape[2:])
+        for ch in self.channels:
+            x = ConvSequence(ch, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = x.reshape((T * B, -1))
+        x = nn.relu(nn.Dense(self.hidden_size, dtype=self.compute_dtype)(x))
+        x = x.astype(jnp.float32).reshape((T, B, self.hidden_size))
+        if self.use_lstm:
+            x, core_state = LSTMCore(hidden_size=self.lstm_size)(
+                x, done, core_state
+            )
+        policy_logits = nn.Dense(self.num_actions)(x)
+        baseline = nn.Dense(1)(x).squeeze(-1)
+        return (policy_logits, baseline), core_state
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        if self.use_lstm:
+            z = jnp.zeros((batch_size, self.lstm_size), jnp.float32)
+            return (z, z)
+        return ()

@@ -19,6 +19,7 @@ import threading
 from collections import deque
 from typing import Any, Optional
 
+import jax
 import numpy as np
 
 from ..utils import nest
@@ -57,20 +58,28 @@ class Batcher:
                     self._pending_stack[: self.batch_size],
                     self._pending_stack[self.batch_size :],
                 )
-                self._ready.append(nest.stack_fields(items, axis=self.dim))
+                self._ready.append(
+                    self._stage(nest.stack_fields(items, axis=self.dim))
+                )
                 self._lock.notify_all()
 
     def cat(self, tree: Any) -> None:
         """Add an already-batched structure; splits/carries past batch_size."""
         with self._lock:
             self._check_open()
-            leaves = nest.flatten(tree)
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
             rows = leaves[0].shape[self.dim]
             for leaf in leaves:
                 if leaf.shape[self.dim] != rows:
                     raise ValueError(
                         f"inconsistent batch axis in cat(): "
                         f"{leaf.shape[self.dim]} != {rows}"
+                    )
+            if self._pending_cat:
+                prev = jax.tree_util.tree_structure(self._pending_cat[0])
+                if treedef != prev:
+                    raise ValueError(
+                        f"cat() tree structure mismatch: {treedef} != {prev}"
                     )
             self._pending_cat.append(tree)
             self._pending_cat_rows += rows
@@ -86,16 +95,23 @@ class Batcher:
             n_full, remainder = divmod(total, self.batch_size)
             for i in range(n_full):
                 self._ready.append(
-                    nest.slice_fields(
-                        merged,
-                        i * self.batch_size,
-                        (i + 1) * self.batch_size,
-                        self.dim,
+                    self._stage(
+                        nest.slice_fields(
+                            merged,
+                            i * self.batch_size,
+                            (i + 1) * self.batch_size,
+                            self.dim,
+                        )
                     )
                 )
             if remainder:
+                rest = nest.slice_fields(merged, total - remainder, total, self.dim)
+                # Copy: a view would pin the whole merged buffer in memory.
                 self._pending_cat = [
-                    nest.slice_fields(merged, total - remainder, total, self.dim)
+                    jax.tree_util.tree_map(
+                        lambda x: x if isinstance(x, jax.Array) else np.array(x),
+                        rest,
+                    )
                 ]
             else:
                 self._pending_cat = []
@@ -122,8 +138,7 @@ class Batcher:
                 raise TimeoutError("Batcher.get timed out")
             if not self._ready:
                 raise RuntimeError("Batcher is closed")
-            batch = self._ready.popleft()
-        return self._to_device(batch)
+            return self._ready.popleft()
 
     def close(self) -> None:
         with self._lock:
@@ -136,11 +151,12 @@ class Batcher:
         if self._closed:
             raise RuntimeError("Batcher is closed")
 
-    def _to_device(self, batch: Any) -> Any:
+    def _stage(self, batch: Any) -> Any:
+        """Dispatch H2D staging at batch-completion time (producer side), so
+        the async transfer overlaps accumulation of the next batch and get()
+        returns an already-staged jax.Array."""
         if self.device is None:
             return batch
-        import jax
-
         # One batched device_put for the whole structure, not one per leaf.
         return jax.device_put(
             jax.tree_util.tree_map(np.asarray, batch), self.device
