@@ -1,0 +1,3 @@
+from .pool import EnvPool, EnvStepper, EnvStepperFuture
+
+__all__ = ["EnvPool", "EnvStepper", "EnvStepperFuture"]

@@ -1,10 +1,9 @@
 from .a2c import A2CNet
-from .core import FeedForwardCore, LSTMCore
+from .core import LSTMCore
 from .impala import ConvSequence, ImpalaNet, ResidualBlock
 
 __all__ = [
     "A2CNet",
-    "FeedForwardCore",
     "LSTMCore",
     "ConvSequence",
     "ImpalaNet",

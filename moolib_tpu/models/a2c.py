@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .core import FeedForwardCore, LSTMCore
+from .core import LSTMCore
 
 __all__ = ["A2CNet"]
 

@@ -7,7 +7,8 @@ version: the unroll is an ``nn.scan`` (lax.scan under the hood) over the time
 axis, with per-step state resets expressed as a masked multiply — static
 shapes, no Python loops, the whole unroll fuses into one XLA computation.
 
-All agent models in this package share one calling convention:
+Feed-forward agents simply use an empty core-state tuple; there is no
+separate identity-core module. All agent models share one calling convention:
 
     (logits_TBA, baseline_TB), new_state = model.apply(
         params, obs_TBx, done_TB, core_state)
@@ -24,19 +25,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-__all__ = ["LSTMCore", "FeedForwardCore"]
-
-
-class FeedForwardCore(nn.Module):
-    """Identity core: no recurrence, empty state tuple."""
-
-    @nn.compact
-    def __call__(self, x, done, state):
-        return x, state
-
-    @staticmethod
-    def initial_state(batch_size: int) -> Tuple:
-        return ()
+__all__ = ["LSTMCore"]
 
 
 class _MaskedLSTMStep(nn.Module):

@@ -27,6 +27,19 @@ from ..utils import nest
 __all__ = ["Batcher"]
 
 
+class _Slot:
+    """Ordered placeholder in the ready queue: reserved under the lock at
+    batch-completion time, filled outside the lock after host assembly and
+    (optional) H2D staging, so transfers never block other producers or
+    consumers on the Condition."""
+
+    __slots__ = ("batch", "done")
+
+    def __init__(self):
+        self.batch = None
+        self.done = False
+
+
 class Batcher:
     def __init__(
         self,
@@ -53,15 +66,17 @@ class Batcher:
         with self._lock:
             self._check_open()
             self._pending_stack.append(tree)
-            if len(self._pending_stack) >= self.batch_size:
-                items, self._pending_stack = (
-                    self._pending_stack[: self.batch_size],
-                    self._pending_stack[self.batch_size :],
-                )
-                self._ready.append(
-                    self._stage(nest.stack_fields(items, axis=self.dim))
-                )
-                self._lock.notify_all()
+            if len(self._pending_stack) < self.batch_size:
+                return
+            items, self._pending_stack = (
+                self._pending_stack[: self.batch_size],
+                self._pending_stack[self.batch_size :],
+            )
+            slot = _Slot()
+            self._ready.append(slot)
+        # Assemble + stage outside the lock.
+        batch = self._stage(nest.stack_fields(items, axis=self.dim))
+        self._fill(slot, batch)
 
     def cat(self, tree: Any) -> None:
         """Add an already-batched structure; splits/carries past batch_size."""
@@ -93,17 +108,15 @@ class Batcher:
             )
             total = self._pending_cat_rows
             n_full, remainder = divmod(total, self.batch_size)
-            for i in range(n_full):
-                self._ready.append(
-                    self._stage(
-                        nest.slice_fields(
-                            merged,
-                            i * self.batch_size,
-                            (i + 1) * self.batch_size,
-                            self.dim,
-                        )
-                    )
+            raws = [
+                nest.slice_fields(
+                    merged,
+                    i * self.batch_size,
+                    (i + 1) * self.batch_size,
+                    self.dim,
                 )
+                for i in range(n_full)
+            ]
             if remainder:
                 rest = nest.slice_fields(merged, total - remainder, total, self.dim)
                 # Copy: a view would pin the whole merged buffer in memory.
@@ -116,14 +129,18 @@ class Batcher:
             else:
                 self._pending_cat = []
             self._pending_cat_rows = remainder
-            self._lock.notify_all()
+            slots = [_Slot() for _ in raws]
+            self._ready.extend(slots)
+        # Stage the emitted batches outside the lock, in reserved order.
+        for slot, raw in zip(slots, raws):
+            self._fill(slot, self._stage(raw))
 
     # -- consumer side ------------------------------------------------------
 
     def empty(self) -> bool:
         """True when no completed batch is ready (reference get/empty contract)."""
         with self._lock:
-            return not self._ready
+            return not (self._ready and self._ready[0].done)
 
     def get(self, timeout: Optional[float] = None) -> Any:
         """Block until a completed batch is available and return it.
@@ -133,12 +150,13 @@ class Batcher:
         """
         with self._lock:
             if not self._lock.wait_for(
-                lambda: self._ready or self._closed, timeout=timeout
+                lambda: (self._ready and self._ready[0].done) or self._closed,
+                timeout=timeout,
             ):
                 raise TimeoutError("Batcher.get timed out")
-            if not self._ready:
+            if not (self._ready and self._ready[0].done):
                 raise RuntimeError("Batcher is closed")
-            return self._ready.popleft()
+            return self._ready.popleft().batch
 
     def close(self) -> None:
         with self._lock:
@@ -150,6 +168,12 @@ class Batcher:
     def _check_open(self):
         if self._closed:
             raise RuntimeError("Batcher is closed")
+
+    def _fill(self, slot: "_Slot", batch: Any) -> None:
+        with self._lock:
+            slot.batch = batch
+            slot.done = True
+            self._lock.notify_all()
 
     def _stage(self, batch: Any) -> Any:
         """Dispatch H2D staging at batch-completion time (producer side), so

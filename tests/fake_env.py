@@ -1,0 +1,47 @@
+"""Deterministic fake environment for EnvPool tests (module-level so it
+pickles into spawn workers). Mirrors the reference's strategy of a pure-Python
+env with deterministic dynamics asserted against an in-process copy
+(reference: test/unit/test_envpool.py:13-88)."""
+
+import numpy as np
+
+
+class FakeEnv:
+    """obs = [seed, t, last_action]; reward = seed + t*action; episode len varies."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.t = 0
+        self.episode_len = 3 + seed % 4
+
+    def reset(self):
+        self.t = 0
+        return self._obs(-1), {}
+
+    def step(self, action):
+        action = int(action)
+        self.t += 1
+        reward = float(self.seed + self.t * action)
+        done = self.t >= self.episode_len
+        return self._obs(action), reward, done, False, {}
+
+    def _obs(self, last_action):
+        return np.array(
+            [self.seed, self.t, last_action], dtype=np.float32
+        )
+
+    def close(self):
+        pass
+
+
+class DictObsEnv(FakeEnv):
+    def _obs(self, last_action):
+        return {
+            "pos": np.array([self.seed, self.t], np.float32),
+            "vel": np.array([last_action], np.int32),
+        }
+
+
+class BadEnv:
+    def __init__(self, seed: int):
+        raise RuntimeError("boom at construction")
