@@ -1,0 +1,30 @@
+from .rpc import Future, Queue, Rpc, RpcDeferredReturn, RpcError
+
+__all__ = [
+    "Future",
+    "Queue",
+    "Rpc",
+    "RpcDeferredReturn",
+    "RpcError",
+    "Broker",
+    "Group",
+    "AllReduce",
+]
+
+
+def __getattr__(name):
+    # Broker/Group/AllReduce live in their own modules (built on Rpc).
+    try:
+        if name == "Broker":
+            from .broker import Broker
+
+            return Broker
+        if name in ("Group", "AllReduce"):
+            from . import group as _group
+
+            return getattr(_group, name)
+    except ImportError as e:
+        raise AttributeError(
+            f"moolib_tpu.rpc.{name} is not available yet: {e}"
+        ) from e
+    raise AttributeError(f"module 'moolib_tpu.rpc' has no attribute {name!r}")

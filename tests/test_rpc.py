@@ -1,0 +1,258 @@
+"""Loopback RPC tests — N peers inside one process over 127.0.0.1/unix
+sockets (reference strategy: test/unit/test_simple.py:16-70,
+test/unit/test_tensors.py, test/unit/test_pickle.py, test/test_batch.py)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu.rpc import Rpc, RpcError
+
+
+@pytest.fixture
+def pair():
+    host = Rpc("host")
+    client = Rpc("client")
+    host.listen("127.0.0.1:0")
+    client.connect(host.debug_info()["listen"][0])
+    yield host, client
+    client.close()
+    host.close()
+
+
+def test_sync_call(pair):
+    host, client = pair
+    host.define("add", lambda a, b: a + b)
+    assert client.sync("host", "add", 2, 3) == 5
+
+
+def test_async_call_and_kwargs(pair):
+    host, client = pair
+    host.define("fmt", lambda x, suffix="!": f"{x}{suffix}")
+    fut = client.async_("host", "fmt", "hi", suffix="?")
+    assert fut.result(timeout=10) == "hi?"
+    assert fut.done()
+
+
+def test_async_callback(pair):
+    host, client = pair
+    host.define("double", lambda x: 2 * x)
+    got = {}
+    ev = threading.Event()
+
+    def cb(result, error):
+        got["result"], got["error"] = result, error
+        ev.set()
+
+    client.async_callback("host", "double", cb, 21)
+    assert ev.wait(10)
+    assert got["result"] == 42 and got["error"] is None
+
+
+def test_bidirectional(pair):
+    host, client = pair
+    host.define("ping", lambda: "pong")
+    client.define("rping", lambda: "rpong")
+    assert client.sync("host", "ping") == "pong"
+    # Host can call back over the same connection (peer learned via greeting).
+    assert host.sync("client", "rping") == "rpong"
+
+
+def test_remote_exception(pair):
+    host, client = pair
+
+    def boom():
+        raise ValueError("kapow")
+
+    host.define("boom", boom)
+    with pytest.raises(RpcError, match="kapow"):
+        client.sync("host", "boom")
+
+
+def test_unknown_function(pair):
+    host, client = pair
+    with pytest.raises(RpcError, match="not found"):
+        client.sync("host", "nope")
+
+
+def test_unknown_peer_times_out():
+    rpc = Rpc("lonely")
+    rpc.set_timeout(0.5)
+    try:
+        fut = rpc.async_("ghost", "fn")
+        with pytest.raises(RpcError, match="timed out"):
+            fut.result(timeout=10)
+    finally:
+        rpc.close()
+
+
+def test_tensor_payloads(pair, rng):
+    host, client = pair
+    host.define("matmul", lambda a, b: a @ b)
+    a = rng.standard_normal((16, 8)).astype(np.float32)
+    b = rng.standard_normal((8, 4)).astype(np.float32)
+    out = client.sync("host", "matmul", a, b)
+    np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+
+def test_nested_tensor_dict(pair, rng):
+    host, client = pair
+    host.define("echo", lambda tree: tree)
+    tree = {"x": rng.standard_normal((3, 3)), "y": [np.int64(2), "s"]}
+    out = client.sync("host", "echo", tree)
+    np.testing.assert_array_equal(out["x"], tree["x"])
+    assert out["y"] == [2, "s"]
+
+
+class Slots:
+    __slots__ = ("a", "b")
+
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def __getstate__(self):
+        return (self.a, self.b)
+
+    def __setstate__(self, st):
+        self.a, self.b = st
+
+    def __eq__(self, other):
+        return (self.a, self.b) == (other.a, other.b)
+
+
+def test_pickled_custom_class(pair):
+    host, client = pair
+    host.define("echo2", lambda o: o)
+    assert client.sync("host", "echo2", Slots(1, "z")) == Slots(1, "z")
+
+
+def test_undefine(pair):
+    host, client = pair
+    host.define("temp", lambda: 1)
+    assert client.sync("host", "temp") == 1
+    host.undefine("temp")
+    with pytest.raises(RpcError, match="not found"):
+        client.sync("host", "temp")
+
+
+def test_define_decorator(pair):
+    host, client = pair
+
+    @host.define("decorated")
+    def decorated(x):
+        return x + 1
+
+    assert client.sync("host", "decorated", 1) == 2
+
+
+def test_concurrent_calls(pair):
+    host, client = pair
+    host.define("slow_id", lambda x: (time.sleep(0.01), x)[1])
+    futs = [client.async_("host", "slow_id", i) for i in range(50)]
+    assert [f.result(timeout=30) for f in futs] == list(range(50))
+
+
+def test_deferred_return(pair):
+    host, client = pair
+    pending = []
+
+    def handler(dr, x):
+        pending.append((dr, x))
+
+    host.define_deferred("later", handler)
+    fut = client.async_("host", "later", 7)
+    for _ in range(100):
+        if pending:
+            break
+        time.sleep(0.05)
+    dr, x = pending[0]
+    assert not fut.done()
+    dr(x * 10)
+    assert fut.result(timeout=10) == 70
+
+
+def test_queue(pair):
+    host, client = pair
+    q = host.define_queue("qfn")
+    fut = client.async_("host", "qfn", 5)
+    return_cb, args, kwargs = q.get(timeout=10)
+    assert args == (5,) and kwargs == {}
+    return_cb(args[0] + 1)
+    assert fut.result(timeout=10) == 6
+
+
+def test_batched_define(pair, rng):
+    """define(batch_size=) stacks concurrent calls (reference: test_batch.py)."""
+    host, client = pair
+    calls = []
+
+    def batched(x):
+        calls.append(x.shape[0])
+        return x * 2
+
+    host.define("bdouble", batched, batch_size=8)
+    xs = [rng.standard_normal(3).astype(np.float32) for _ in range(16)]
+    futs = [client.async_("host", "bdouble", x) for x in xs]
+    for x, f in zip(xs, futs):
+        np.testing.assert_allclose(f.result(timeout=30), x * 2, rtol=1e-6)
+    assert max(calls) > 1  # at least some calls actually batched
+
+
+def test_batched_queue_dynamic(pair):
+    host, client = pair
+    q = host.define_queue("bq", batch_size=4, dynamic_batching=True)
+    futs = [client.async_("host", "bq", np.float32(i)) for i in range(6)]
+    served = 0
+    while served < 6:
+        return_cb, args, kwargs = q.get(timeout=10)
+        (vals,) = args
+        return_cb(vals + 1)
+        served += return_cb.batch_size
+    for i, f in enumerate(futs):
+        assert f.result(timeout=10) == pytest.approx(i + 1)
+
+
+def test_three_peer_discovery():
+    """C discovers A through B's gossip (reference: findPeer)."""
+    a, b, c = Rpc("A"), Rpc("B"), Rpc("C")
+    try:
+        a.listen("127.0.0.1:0")
+        b.listen("127.0.0.1:0")
+        a_addr = a.debug_info()["listen"][0]
+        b_addr = b.debug_info()["listen"][0]
+        # B knows A; C knows only B.
+        b.connect(a_addr)
+        c.connect(b_addr)
+        a.define("hello", lambda: "from A")
+        time.sleep(0.3)  # let greetings land
+        assert c.async_("A", "hello").result(timeout=10) == "from A"
+    finally:
+        for r in (a, b, c):
+            r.close()
+
+
+def test_unix_transport():
+    host, client = Rpc("uh"), Rpc("uc")
+    try:
+        host.listen("unix:mlt-test-unix-sock")
+        host.define("f", lambda: "ok")
+        client.connect("unix:mlt-test-unix-sock")
+        assert client.sync("uh", "f") == "ok"
+        info = client.debug_info()
+        assert "unix" in info["peers"]["uh"]["connections"]
+    finally:
+        client.close()
+        host.close()
+
+
+def test_debug_info(pair):
+    host, client = pair
+    host.define("n", lambda: None)
+    client.sync("host", "n")
+    info = client.debug_info()
+    assert info["name"] == "client"
+    assert "host" in info["peers"]
+    conns = info["peers"]["host"]["connections"]
+    assert any(c["latency_ms"] >= 0 for c in conns.values())

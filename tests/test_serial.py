@@ -1,0 +1,101 @@
+"""Round-trip property tests for the wire serializer (reference strategy:
+property-test serialization against identity, src/serialization.h contract)."""
+
+import numpy as np
+import pytest
+
+from moolib_tpu.rpc import serial
+
+
+def _roundtrip(obj):
+    frames = serial.serialize(7, 1234, obj)
+    blob = b"".join(bytes(f) for f in frames)
+    magic, body_len = serial.HEADER.unpack(blob[: serial.HEADER.size])
+    assert magic == serial.MAGIC
+    body = blob[serial.HEADER.size :]
+    assert len(body) == body_len
+    rid, fid, out = serial.deserialize_body(memoryview(body))
+    assert rid == 7 and fid == 1234
+    return out
+
+
+def test_scalars():
+    for v in [None, True, False, 0, -5, 2**40, 2**100, -(2**100), 3.5,
+              "héllo", b"bytes", ""]:
+        out = _roundtrip(v)
+        assert out == v and type(out) is type(v)
+
+
+def test_containers():
+    obj = {"a": [1, 2.5, None], "b": (True, "x"), 3: {"nested": b"zz"}}
+    assert _roundtrip(obj) == obj
+
+
+def test_tensors_zero_copy(rng):
+    arrs = {
+        "f32": rng.standard_normal((4, 5)).astype(np.float32),
+        "u8": rng.integers(0, 255, (3, 2, 2)).astype(np.uint8),
+        "i64": rng.integers(-100, 100, (7,)),
+        "bool": rng.integers(0, 2, (4,)).astype(bool),
+        "scalar0d": np.float32(3.25),
+        "empty": np.zeros((0, 3), np.float32),
+    }
+    out = _roundtrip(arrs)
+    for k, a in arrs.items():
+        np.testing.assert_array_equal(out[k], np.asarray(a))
+        assert out[k].dtype == np.asarray(a).dtype
+
+
+def test_jax_arrays():
+    import jax.numpy as jnp
+
+    obj = (jnp.arange(6.0).reshape(2, 3), {"x": jnp.ones(4, jnp.bfloat16)})
+    out = _roundtrip(obj)
+    np.testing.assert_array_equal(out[0], np.arange(6.0).reshape(2, 3))
+    assert out[1]["x"].dtype == np.asarray(obj[1]["x"]).dtype
+
+
+def test_pickle_fallback():
+    class Custom:
+        __slots__ = ("a", "b")
+
+        def __init__(self, a, b):
+            self.a, self.b = a, b
+
+        def __eq__(self, other):
+            return (self.a, self.b) == (other.a, other.b)
+
+        def __getstate__(self):
+            return (self.a, self.b)
+
+        def __setstate__(self, st):
+            self.a, self.b = st
+
+    # module-level pickling requires the class importable; define via global
+    globals()["Custom"] = Custom
+    Custom.__qualname__ = "Custom"
+    out = _roundtrip({"obj": Custom(1, "two")})
+    assert out["obj"] == Custom(1, "two")
+
+
+def test_mixed_structure_with_tensors(rng):
+    obj = (
+        (np.float32(1.5), [rng.standard_normal(3), "s"]),
+        {"k": (rng.integers(0, 9, (2, 2)), None)},
+    )
+    out = _roundtrip(obj)
+    np.testing.assert_array_equal(out[0][1][0], obj[0][1][0])
+    np.testing.assert_array_equal(out[1]["k"][0], obj[1]["k"][0])
+
+
+def test_truncated_raises():
+    frames = serial.serialize(1, 2000, {"x": np.arange(10)})
+    blob = b"".join(bytes(f) for f in frames)
+    with pytest.raises(ValueError):
+        serial.deserialize_body(memoryview(blob[serial.HEADER.size : -8]))
+
+
+def test_noncontiguous_tensor(rng):
+    a = rng.standard_normal((6, 8))[::2, 1::3]
+    out = _roundtrip(a)
+    np.testing.assert_array_equal(out, a)
