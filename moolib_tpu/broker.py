@@ -1,0 +1,42 @@
+"""Broker CLI: ``python -m moolib_tpu.broker [addr]``.
+
+Capability parity with the reference CLI (reference: py/moolib/broker.py —
+default port 4431, 0.25s update loop)."""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from .rpc import Rpc
+from .rpc.broker import DEFAULT_PORT, Broker
+from .utils import set_log_level, set_logging
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="moolib_tpu broker")
+    parser.add_argument(
+        "addr", nargs="?", default=f"0.0.0.0:{DEFAULT_PORT}",
+        help="listen address (host:port or unix:path)",
+    )
+    parser.add_argument("--interval", type=float, default=0.25)
+    args = parser.parse_args(argv)
+
+    set_logging(True)
+    set_log_level("info")
+    rpc = Rpc("broker")
+    rpc.listen(args.addr)
+    broker = Broker(rpc)
+    print(f"moolib_tpu broker listening on {rpc.debug_info()['listen']}")
+    try:
+        while True:
+            broker.update()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rpc.close()
+
+
+if __name__ == "__main__":
+    main()

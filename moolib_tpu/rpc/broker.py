@@ -1,0 +1,173 @@
+"""Broker: cluster membership authority.
+
+Capability parity with the reference's Broker (reference: src/broker.h:97-265
+— one broker per cluster tracks per-group peers by ping, expires silent
+peers, and re-syncs groups by assigning a new syncId and pushing the sorted
+member list; CLI at py/moolib/broker.py).
+
+Protocol redesign (same guarantees, one fewer round trip): the reference runs
+a 2-phase resync (sync → collect acks → update). Here the broker pushes a
+single ``GroupService::update`` carrying both the new sync id and the sorted
+member list; atomic epoch switching is preserved because collective ops are
+keyed by sync id on every peer (see group.py), so peers in different epochs
+can never complete an op together. Peers report their current sync id in each
+ping, and the broker re-pushes to any peer that reports a stale one — missed
+pushes heal within one ping interval.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..utils import get_logger
+from .rpc import Rpc
+
+log = get_logger("broker")
+
+__all__ = ["Broker", "DEFAULT_PORT"]
+
+DEFAULT_PORT = 4431  # reference default (py/moolib/broker.py)
+
+
+@dataclass
+class _PeerEntry:
+    timeout: float
+    sort_order: int
+    creation_order: int
+    last_ping: float = field(default_factory=time.monotonic)
+    synced_id: Optional[str] = None
+    push_inflight: bool = False
+    last_push: float = 0.0
+
+
+@dataclass
+class _GroupEntry:
+    sync_id: str
+    peers: Dict[str, _PeerEntry] = field(default_factory=dict)
+    needs_update: bool = False
+    creation_counter: int = 0
+
+    def sorted_members(self):
+        # Sort by (sort_order, creation_order) like the reference
+        # (src/broker.h:134-190).
+        return [
+            name
+            for name, _ in sorted(
+                self.peers.items(),
+                key=lambda kv: (kv[1].sort_order, kv[1].creation_order),
+            )
+        ]
+
+
+class Broker:
+    """Membership authority service bound to an Rpc instance.
+
+    Usage (mirrors the reference CLI loop)::
+
+        rpc = Rpc("broker"); rpc.listen(addr)
+        broker = Broker(rpc)
+        while True:
+            broker.update(); time.sleep(0.25)
+    """
+
+    def __init__(self, rpc: Optional[Rpc] = None, name: str = "broker"):
+        self._owns_rpc = rpc is None
+        self.rpc = rpc or Rpc(name)
+        self._groups: Dict[str, _GroupEntry] = {}
+        # _ping runs on RPC executor threads while update() runs on the CLI
+        # thread; one lock covers all membership state.
+        self._lock = threading.Lock()
+        self.rpc.define("BrokerService::ping", self._ping)
+
+    # -- service -------------------------------------------------------------
+
+    def _ping(self, group: str, peer_name: str, timeout: float,
+              sync_id: Optional[str], sort_order: int = 0) -> dict:
+        with self._lock:
+            g = self._groups.get(group)
+            if g is None:
+                g = self._groups[group] = _GroupEntry(sync_id=_new_sync_id())
+            entry = g.peers.get(peer_name)
+            if entry is None:
+                entry = g.peers[peer_name] = _PeerEntry(
+                    timeout=timeout,
+                    sort_order=sort_order,
+                    creation_order=g.creation_counter,
+                )
+                g.creation_counter += 1
+                g.needs_update = True
+                log.info("group %s: peer %s joined", group, peer_name)
+            entry.last_ping = time.monotonic()
+            entry.timeout = timeout
+            entry.synced_id = sync_id
+            return {"sync_id": g.sync_id}
+
+    # -- 4Hz maintenance loop ------------------------------------------------
+
+    def update(self):
+        """Expire silent peers and push membership epochs
+        (reference: BrokerService::update, src/broker.h:130-237)."""
+        now = time.monotonic()
+        pushes = []
+        with self._lock:
+            for group_name, g in self._groups.items():
+                expired = [
+                    name
+                    for name, e in g.peers.items()
+                    if now - e.last_ping > e.timeout
+                ]
+                for name in expired:
+                    del g.peers[name]
+                    g.needs_update = True
+                    log.info("group %s: peer %s expired", group_name, name)
+                if g.needs_update:
+                    g.sync_id = _new_sync_id()
+                    g.needs_update = False
+                members = g.sorted_members()
+                for name, e in g.peers.items():
+                    if (
+                        e.synced_id != g.sync_id
+                        and not e.push_inflight
+                        and now - e.last_push > 0.5
+                    ):
+                        e.push_inflight = True
+                        e.last_push = now
+                        pushes.append((group_name, g, name, members))
+        for args in pushes:
+            self._push_update(*args)
+
+    def _push_update(self, group_name: str, g: _GroupEntry, peer: str, members):
+        sync_id = g.sync_id
+
+        def on_done(result, error):
+            with self._lock:
+                entry = g.peers.get(peer)
+                if entry is not None:
+                    entry.push_inflight = False
+                    if error is None:
+                        entry.synced_id = sync_id
+            # On error the peer stays stale and is re-pushed next update()
+            # (or expires) — the self-healing replacement for 2-phase acks.
+
+        self.rpc.async_callback(
+            peer, "GroupService::update", on_done, group_name, sync_id, members
+        )
+
+    def groups(self) -> dict:
+        with self._lock:
+            return {
+                name: {"sync_id": g.sync_id, "members": g.sorted_members()}
+                for name, g in self._groups.items()
+            }
+
+    def close(self):
+        if self._owns_rpc:
+            self.rpc.close()
+
+
+def _new_sync_id() -> str:
+    return secrets.token_hex(16)

@@ -1,0 +1,353 @@
+"""Group membership view + async tree allreduce over RPC.
+
+Capability parity with the reference's Group/AllReduce services
+(reference: src/group.h — GroupService client view :330-491 pinging the
+broker and swapping member lists on syncId change; AllReduceService
+:508-788: binary-tree reduce up / broadcast down with out-of-order arrival
+parking, per-op naming "{syncId}.{group}::{name}", builtin Sum/Product/
+Min/Max or arbitrary local op, and cancellation of in-flight ops on
+membership change).
+
+TPU context: this DCN-level collective is the *elastic, cross-cohort* path
+(gradients between independently-failing hosts, stats, leader election).
+Dense intra-cohort gradient reduction rides XLA collectives on the ICI mesh
+instead (see moolib_tpu.parallel) — the reference has only this software
+tree (its only collective), so the TPU build strictly dominates it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from ..utils import get_logger, nest
+from .rpc import Future, Rpc, RpcError
+
+log = get_logger("group")
+
+__all__ = ["Group", "AllReduce", "REDUCE_OPS"]
+
+
+def _sum(a, b):
+    return np.add(a, b)
+
+
+def _prod(a, b):
+    return np.multiply(a, b)
+
+
+def _min(a, b):
+    return np.minimum(a, b)
+
+
+def _max(a, b):
+    return np.maximum(a, b)
+
+
+REDUCE_OPS: Dict[str, Callable] = {
+    "sum": _sum,
+    "product": _prod,
+    "min": _min,
+    "max": _max,
+}
+
+
+class AllReduce(Future):
+    """Future for one collective op (reference surface: moolib.AllReduce)."""
+
+    def __init__(self, op_key: str):
+        super().__init__()
+        self.op_key = op_key
+
+
+class _Op:
+    __slots__ = ("key", "data", "op_fn", "children", "received",
+                 "future", "started", "index", "members", "forwarded")
+
+    def __init__(self, key, data, op_fn, index, members, future):
+        self.key = key
+        self.data = data
+        self.op_fn = op_fn
+        self.index = index
+        self.members = members
+        n = len(members)
+        self.children = [
+            c for c in (2 * index + 1, 2 * index + 2) if c < n
+        ]
+        self.received = 0
+        self.future = future
+        self.started = time.monotonic()
+        self.forwarded = False
+
+
+class Group:
+    """Client-side membership view + collectives for one named group.
+
+    Mirrors the reference Python surface (reference: src/moolib.cc
+    GroupWrapper): ``update()`` from the training loop, ``members``/
+    ``sync_id`` properties, ``all_reduce(name, data, op)``.
+    """
+
+    _PING_INTERVAL = 1.0  # reference pings every <=4s (src/group.h:425-451)
+
+    def __init__(self, rpc: Rpc, broker_name: str = "broker",
+                 group_name: str = "default", timeout: float = 10.0,
+                 sort_order: int = 0):
+        self.rpc = rpc
+        self.broker_name = broker_name
+        self.group_name = group_name
+        self.timeout = timeout
+        self.sort_order = sort_order
+        self._lock = threading.RLock()
+        self._sync_id: Optional[str] = None
+        self._members: List[str] = []
+        self._last_ping = 0.0
+        self._ping_inflight = False
+        self._active: Dict[str, _Op] = {}
+        self._parked: Dict[str, List[tuple]] = {}
+        self._shared_state(rpc).register(self)
+
+    # Per-Rpc shared dispatch for the three service functions.
+    class _Shared:
+        def __init__(self, rpc: Rpc):
+            self.groups: Dict[str, "Group"] = {}
+            rpc.define("GroupService::update", self._on_update)
+            rpc.define("AllReduceService::reduce", self._on_reduce)
+            rpc.define("AllReduceService::share", self._on_share)
+
+        def register(self, group: "Group"):
+            self.groups[group.group_name] = group
+
+        def _on_update(self, group_name, sync_id, members):
+            g = self.groups.get(group_name)
+            if g is not None:
+                g._apply_sync(sync_id, members)
+            return True
+
+        def _on_reduce(self, op_key, payload):
+            g = self.groups.get(_group_of(op_key))
+            if g is not None:
+                g._reduce_in(op_key, payload)
+            return True
+
+        def _on_share(self, op_key, result):
+            g = self.groups.get(_group_of(op_key))
+            if g is not None:
+                g._share_in(op_key, result)
+            return True
+
+    @staticmethod
+    def _shared_state(rpc: Rpc) -> "Group._Shared":
+        shared = getattr(rpc, "_moolib_group_shared", None)
+        if shared is None:
+            shared = Group._Shared(rpc)
+            rpc._moolib_group_shared = shared
+        return shared
+
+    # -- membership ----------------------------------------------------------
+
+    @property
+    def sync_id(self) -> Optional[str]:
+        return self._sync_id
+
+    @property
+    def members(self) -> List[str]:
+        return list(self._members)
+
+    @property
+    def rank(self) -> Optional[int]:
+        with self._lock:
+            try:
+                return self._members.index(self.rpc.get_name())
+            except ValueError:
+                return None
+
+    def active(self) -> bool:
+        return self._sync_id is not None and self.rpc.get_name() in self._members
+
+    def update(self):
+        """Heartbeat; call regularly from the training loop
+        (reference: GroupService::update client side, src/group.h:394-490)."""
+        now = time.monotonic()
+        if not self._ping_inflight and now - self._last_ping >= self._PING_INTERVAL:
+            self._ping_inflight = True
+            self._last_ping = now
+
+            def on_pong(result, error):
+                self._ping_inflight = False
+                if error is not None:
+                    log.debug("broker ping failed: %s", error)
+
+            self.rpc.async_callback(
+                self.broker_name, "BrokerService::ping", on_pong,
+                self.group_name, self.rpc.get_name(), self.timeout,
+                self._sync_id, self.sort_order,
+            )
+        self._expire_ops()
+
+    def _apply_sync(self, sync_id: str, members: List[str]):
+        with self._lock:
+            if sync_id == self._sync_id:
+                self._members = list(members)
+                return
+            old = self._sync_id
+            self._sync_id = sync_id
+            self._members = list(members)
+            # Cancel every in-flight op from the previous epoch
+            # (reference: src/group.h:453-460).
+            cancelled = list(self._active.values())
+            self._active.clear()
+            self._parked.clear()
+        for op in cancelled:
+            op.future._set_exception(
+                RpcError(f"allreduce {op.key} cancelled: membership changed")
+            )
+        if old is not None:
+            log.info("group %s: resync %s -> %s (%d members)",
+                     self.group_name, old[:8], sync_id[:8], len(members))
+
+    def _expire_ops(self):
+        now = time.monotonic()
+        expired = []
+        with self._lock:
+            for key, op in list(self._active.items()):
+                if now - op.started > self.timeout:
+                    del self._active[key]
+                    expired.append(op)
+            for key, parked in list(self._parked.items()):
+                self._parked[key] = [
+                    p for p in parked if now - p[2] <= self.timeout
+                ]
+                if not self._parked[key]:
+                    del self._parked[key]
+        for op in expired:
+            op.future._set_exception(
+                RpcError(f"allreduce {op.key} timed out")
+            )
+
+    # -- allreduce -----------------------------------------------------------
+
+    def all_reduce(self, name: str, data: Any,
+                   op: Union[str, Callable] = "sum") -> AllReduce:
+        """Start an async tree allreduce; returns a Future
+        (reference: AllReduceService::allReduce, src/group.h:687-787)."""
+        op_fn = _resolve_op(op)
+        with self._lock:
+            if self._sync_id is None or not self._members:
+                raise RpcError(
+                    f"group {self.group_name!r} not synchronized yet"
+                )
+            me = self.rpc.get_name()
+            if me not in self._members:
+                raise RpcError(f"{me!r} is not a member of {self.group_name!r}")
+            index = self._members.index(me)
+            key = f"{self._sync_id}.{self.group_name}::{name}"
+            if key in self._active:
+                raise RpcError(f"allreduce {name!r} already in flight")
+            fut = AllReduce(key)
+            op_obj = _Op(key, data, op_fn, index, list(self._members), fut)
+            self._active[key] = op_obj
+            parked = self._parked.pop(key, [])
+        # Drain early arrivals from children (reference: src/group.h:771-783).
+        for p_key, payload, _ts in parked:
+            self._reduce_in(p_key, payload)
+        self._maybe_forward(op_obj)
+        return fut
+
+    def _reduce_in(self, op_key: str, payload):
+        """A child's partial arrived (reference: reduce, src/group.h:570-629)."""
+        with self._lock:
+            op = self._active.get(op_key)
+            if op is None:
+                if not _is_current(op_key, self._sync_id):
+                    return  # stale epoch: drop
+                self._parked.setdefault(op_key, []).append(
+                    (op_key, payload, time.monotonic())
+                )
+                return
+            op.data = _apply(op.op_fn, op.data, payload)
+            op.received += 1
+        self._maybe_forward(op)
+
+    def _maybe_forward(self, op: _Op):
+        with self._lock:
+            if op.received < len(op.children):
+                return
+            if self._active.get(op.key) is not op:
+                return  # cancelled meanwhile
+            if op.forwarded:
+                return  # one-shot: parked drains/races must not double-send
+            op.forwarded = True
+            data = op.data
+            index = op.index
+            members = op.members
+        if index == 0:
+            # Root: result complete; broadcast down (src/group.h:553-568).
+            self._share_in(op.key, data)
+        else:
+            parent = members[(index - 1) // 2]
+            self.rpc.async_callback(
+                parent, "AllReduceService::reduce",
+                _log_err(f"reduce->{parent}"), op.key, data,
+            )
+
+    def _share_in(self, op_key: str, result):
+        """Result broadcast from the parent (reference: share,
+        src/group.h:631-654)."""
+        with self._lock:
+            op = self._active.pop(op_key, None)
+        if op is None:
+            return
+        for c in op.children:
+            child = op.members[c]
+            self.rpc.async_callback(
+                child, "AllReduceService::share",
+                _log_err(f"share->{child}"), op_key, result,
+            )
+        op.future._set_result(result)
+
+    def close(self):
+        shared = getattr(self.rpc, "_moolib_group_shared", None)
+        if shared is not None:
+            shared.groups.pop(self.group_name, None)
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _resolve_op(op) -> Callable:
+    if callable(op):
+        return op
+    fn = REDUCE_OPS.get(op)
+    if fn is None:
+        raise RpcError(f"unknown reduce op {op!r}; one of {sorted(REDUCE_OPS)}")
+    return fn
+
+
+def _apply(op_fn, a, b):
+    """Builtin ops apply leaf-wise over trees; custom ops get whole payloads
+    (reference: ReduceVariant dispatch vs python op, src/group.h:230-262)."""
+    if op_fn in (_sum, _prod, _min, _max):
+        return nest.map_structure(op_fn, a, b)
+    return op_fn(a, b)
+
+
+def _group_of(op_key: str) -> str:
+    # "{sync_id}.{group}::{name}"
+    rest = op_key.split(".", 1)[1]
+    return rest.split("::", 1)[0]
+
+
+def _is_current(op_key: str, sync_id: Optional[str]) -> bool:
+    return sync_id is not None and op_key.startswith(sync_id + ".")
+
+
+def _log_err(what: str):
+    def cb(result, error):
+        if error is not None:
+            log.debug("%s failed: %s", what, error)
+
+    return cb

@@ -292,7 +292,7 @@ class _Conn:
 
     __slots__ = (
         "transport", "reader", "writer", "task", "peer_name", "peer_id",
-        "latency", "last_recv", "last_send", "created",
+        "latency", "last_recv", "last_send", "created", "explicit_addr",
     )
 
     def __init__(self, transport: str, reader, writer):
@@ -306,6 +306,7 @@ class _Conn:
         self.last_recv = time.monotonic()
         self.last_send = time.monotonic()
         self.created = time.monotonic()
+        self.explicit_addr: Optional[str] = None
 
 
 class _Peer:
@@ -364,6 +365,7 @@ class Rpc:
         self._recent_rids: "OrderedDict[Tuple[str, int], bool]" = OrderedDict()
         self._response_cache: "OrderedDict[Tuple[str, int], List[Any]]" = OrderedDict()
         self._anon_conns: List[_Conn] = []
+        self._explicit: Dict[str, dict] = {}  # addr -> {conn, last_try}
         self._closed = False
         self._batchers: Dict[str, Any] = {}
 
@@ -461,9 +463,41 @@ class Rpc:
                 pass
 
     def connect(self, addr: str):
-        """Connect to a peer address (fire-and-forget like the reference;
-        failures surface on calls)."""
-        self._call_soon(self._connect_addr(addr))
+        """Connect to a peer address. Explicit connections auto-reconnect
+        until close() (reference: src/rpc.cc:1535-1541); transient dial
+        failures are retried by the timeout loop, so a connect() racing the
+        remote's listen() heals itself."""
+        if self._closed:
+            raise RpcError("Rpc is closed")
+
+        def register():
+            if addr in self._explicit:
+                return  # idempotent: never reset a live registration
+            self._explicit[addr] = {
+                "conn": None, "last_try": 0.0, "dialing": False,
+            }
+            self._loop.create_task(self._dial_explicit(addr))
+
+        try:
+            self._loop.call_soon_threadsafe(register)
+        except RuntimeError as e:
+            raise RpcError(f"Rpc is closed: {e}") from None
+
+    async def _dial_explicit(self, addr: str):
+        entry = self._explicit.get(addr)
+        if entry is None or self._closed or entry["dialing"]:
+            return
+        if entry["conn"] is not None and not entry["conn"].writer.is_closing():
+            return
+        entry["dialing"] = True
+        entry["last_try"] = time.monotonic()
+        try:
+            conn = await self._connect_addr(addr)
+            if conn is not None:
+                conn.explicit_addr = addr
+                entry["conn"] = conn
+        finally:
+            entry["dialing"] = False
 
     async def _connect_addr(self, addr: str) -> Optional[_Conn]:
         scheme, target = _split_addr(addr)
@@ -541,6 +575,10 @@ class Rpc:
             pass
         if conn in self._anon_conns:
             self._anon_conns.remove(conn)
+        if conn.explicit_addr is not None:
+            entry = self._explicit.get(conn.explicit_addr)
+            if entry is not None and entry["conn"] is conn:
+                entry["conn"] = None  # timeout loop re-dials
         if conn.peer_name:
             peer = self._peers.get(conn.peer_name)
             if peer and peer.conns.get(conn.transport) is conn:
@@ -897,6 +935,12 @@ class Rpc:
                         )
                     elif out.conn is None:
                         await self._send_out(out)
+                # re-dial dropped/failed explicit connections
+                for addr, entry in list(self._explicit.items()):
+                    conn = entry["conn"]
+                    dead = conn is None or conn.writer.is_closing()
+                    if dead and not entry["dialing"] and now - entry["last_try"] > 1.0:
+                        self._loop.create_task(self._dial_explicit(addr))
                 # keepalives after 10s silence (reference: rpc.cc:1625-1665)
                 for peer in self._peers.values():
                     for conn in list(peer.conns.values()):
