@@ -1,0 +1,21 @@
+from .accumulator import Accumulator
+from .mesh import (
+    data_parallel_spec,
+    dp_average_grads,
+    make_mesh,
+    pmean_gradients,
+    psum_gradients,
+    replicated_spec,
+    shard_batch,
+)
+
+__all__ = [
+    "Accumulator",
+    "make_mesh",
+    "data_parallel_spec",
+    "replicated_spec",
+    "psum_gradients",
+    "pmean_gradients",
+    "dp_average_grads",
+    "shard_batch",
+]

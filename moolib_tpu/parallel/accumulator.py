@@ -1,0 +1,471 @@
+"""Accumulator: elastic data-parallel gradient accumulation.
+
+Capability parity with the reference's Accumulator (reference:
+src/accumulator.{h,cc} — leader election by max (model_version, name)
+allreduce :581-626; count-then-reduce virtual-batch protocol :1005-1078;
+reduced gradients divided and handed to the user :425-462; joiners request
+model/optimizer/user state from the leader :464-488, 719-759; polling
+contract documented at src/moolib.cc:1645-1862).
+
+TPU-native division of labor:
+- **Intra-cohort** (devices of one host/mesh): gradients never touch this
+  class — they reduce via ``lax.psum``/``pmean`` inside the jitted train
+  step over the ICI mesh (see moolib_tpu.parallel.mesh). That path replaces
+  the reference's pinned-CPU gradient bundles for the dense case.
+- **Cross-cohort** (elastic, DCN): this class reduces *host-level* gradient
+  pytrees (numpy leaves) over the RPC tree allreduce with the reference's
+  virtual-batch-size semantics and elastic membership.
+
+Round protocol (lock-step, stall-free): every member's ``update()`` drives
+small *count rounds* continuously — each round sums (batch_size, n_grads)
+contributed since the last round (zero for idle/unsynced peers, the
+built-in equivalent of ``skip_gradients``). All peers observe identical
+count totals, so when the cumulative count crosses ``virtual_batch_size``
+every peer deterministically joins the same *gradient round*, shipping its
+accumulated local gradient sum (or None). The reduced sum is divided by the
+total sample count and surfaced via ``has_gradients()``/
+``result_gradients()``.
+
+Gradient convention: ``reduce_gradients(grads, batch_size)`` expects
+**batch-sum** gradients (mean-gradient * batch_size); the result handed
+back is the proper per-sample mean over the virtual batch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import get_logger, nest
+from ..rpc.group import Group
+from ..rpc.rpc import Rpc, RpcError
+
+log = get_logger("accumulator")
+
+__all__ = ["Accumulator"]
+
+
+def _to_numpy_tree(tree):
+    return nest.map_structure(np.asarray, tree)
+
+
+def _tree_add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return nest.map_structure(np.add, a, b)
+
+
+def _elect_max(a, b):
+    return max(a, b)
+
+
+def _grad_merge(a, b):
+    """Merge (bundle_or_none, n_grads) pairs."""
+    (ba, na), (bb, nb) = a, b
+    return (_tree_add(ba, bb), na + nb)
+
+
+def _count_merge(a, b):
+    (bsa, nga), (bsb, ngb) = a, b
+    return (bsa + bsb, nga + ngb)
+
+
+class Accumulator:
+    """Elastic DP gradient accumulator over a broker-managed group.
+
+    Polling surface mirrors the reference (reference: src/moolib.cc
+    :1645-1862): ``update()`` every iteration, then check ``connected()``,
+    ``wants_gradients()``/``has_gradients()``, call
+    ``reduce_gradients(grads, batch_size)`` or ``skip_gradients()``, apply
+    the result, ``zero_gradients()``.
+    """
+
+    def __init__(
+        self,
+        rpc: Rpc,
+        group: Optional[Group] = None,
+        broker_name: str = "broker",
+        group_name: str = "default",
+        virtual_batch_size: int = 1,
+        get_state: Optional[Callable[[], Any]] = None,
+        set_state: Optional[Callable[[Any], None]] = None,
+        timeout: float = 10.0,
+    ):
+        self.rpc = rpc
+        self.group = group or Group(
+            rpc, broker_name=broker_name, group_name=group_name, timeout=timeout
+        )
+        self._owns_group = group is None
+        self.virtual_batch_size = virtual_batch_size
+        self._get_state = get_state
+        self._set_state = set_state
+
+        self._lock = threading.RLock()
+        self._model_version = 0
+        self._epoch: Optional[str] = None       # sync_id this state belongs to
+        self._leader: Optional[str] = None
+        self._electing = False
+        self._synced = False                     # model state is current
+        self._state_req_inflight = False
+
+        self._seq = 0                            # count-round sequence
+        self._attempt = 0                        # retry suffix for count keys
+        self._gseq = 0                           # gradient-round sequence
+        self._round_inflight = False
+        self._grad_inflight = False
+        self._cumulative_bs = 0                  # global, same on all peers
+
+        self._pending_bundle = None              # user grads since last round
+        self._pending_bs = 0
+        self._pending_ngrads = 0
+        self._committed_bundle = None            # counted, awaiting grad round
+        self._committed_bs = 0
+        self._committed_ngrads = 0
+
+        self._result: Optional[Tuple[Any, int]] = None  # (mean grads, count)
+        self._user_has_contributed = False
+
+        rpc.define(
+            "AccumulatorService::requestState", self._serve_state
+        )
+
+    # -- reference-parity introspection --------------------------------------
+
+    @property
+    def model_version(self) -> int:
+        return self._model_version
+
+    def set_model_version(self, v: int):
+        """Set before joining so a checkpoint holder wins leader election
+        (reference: src/moolib.cc:1808-1821)."""
+        with self._lock:
+            self._model_version = int(v)
+
+    def is_leader(self) -> bool:
+        return self._leader == self.rpc.get_name()
+
+    def connected(self) -> bool:
+        return self.group.active() and self._leader is not None
+
+    def wants_gradients(self) -> bool:
+        with self._lock:
+            return (
+                self.connected()
+                and self._synced
+                and self._result is None
+                and not self._user_has_contributed
+            )
+
+    def has_gradients(self) -> bool:
+        return self._result is not None
+
+    def result_gradients(self) -> Tuple[Any, int]:
+        """-> (mean gradient pytree, virtual batch count)."""
+        with self._lock:
+            if self._result is None:
+                raise RpcError("no reduced gradients available")
+            return self._result
+
+    # -- user contributions ---------------------------------------------------
+
+    def reduce_gradients(self, grads: Any, batch_size: int):
+        """Contribute batch-sum gradients; they enter the next count round
+        (reference: reduceImpl, src/accumulator.cc:880-1003)."""
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        tree = _to_numpy_tree(grads)
+        with self._lock:
+            self._pending_bundle = _tree_add(self._pending_bundle, tree)
+            self._pending_bs += int(batch_size)
+            self._pending_ngrads += 1
+            self._user_has_contributed = True
+
+    def skip_gradients(self):
+        """Explicitly contribute nothing this cycle (reference contract)."""
+        with self._lock:
+            self._user_has_contributed = True
+
+    def zero_gradients(self):
+        """Consume the reduced result; re-enables wants_gradients."""
+        with self._lock:
+            self._result = None
+            self._user_has_contributed = False
+
+    # -- heartbeat ------------------------------------------------------------
+
+    def update(self):
+        """Drive membership, leader election, state sync, and reduce rounds
+        (reference: AccumulatorImpl::update, src/accumulator.cc:519-666)."""
+        self.group.update()
+        sync_id = self.group.sync_id
+        if sync_id is None:
+            return
+        with self._lock:
+            if sync_id != self._epoch:
+                self._reset_epoch(sync_id)
+            if self._electing or self._leader is None:
+                self._maybe_elect()
+                return
+            if not self._synced:
+                self._maybe_request_state()
+            # Drive one count round at a time; unsynced/idle peers
+            # contribute zeros so collectives never stall.
+            if not self._round_inflight and not self._grad_inflight:
+                self._start_count_round()
+
+    # -- epoch / election -----------------------------------------------------
+
+    def _reset_epoch(self, sync_id: str):
+        log.info("%s: new epoch %s", self.rpc.get_name(), sync_id[:8])
+        self._epoch = sync_id
+        self._leader = None
+        self._electing = False
+        self._synced = False
+        self._state_req_inflight = False
+        self._seq = 0
+        self._attempt = 0
+        self._gseq = 0
+        self._round_inflight = False
+        self._grad_inflight = False
+        self._cumulative_bs = 0
+        # Pending user grads survive a resync; committed ones were bound to
+        # the old epoch's (now discarded) counts and merge back into pending
+        # so they are re-counted and re-reduced in the new epoch.
+        self._pending_bundle = _tree_add(
+            self._committed_bundle, self._pending_bundle
+        )
+        self._pending_bs += self._committed_bs
+        self._pending_ngrads += self._committed_ngrads
+        self._committed_bundle = None
+        self._committed_bs = 0
+        self._committed_ngrads = 0
+
+    def _maybe_elect(self):
+        if self._electing or not self.group.active():
+            return
+        self._electing = True
+        epoch = self._epoch
+
+        def done(fut):
+            try:
+                version, leader = fut.result(timeout=0)
+            except Exception as e:
+                with self._lock:
+                    self._electing = False  # retried next update()
+                    if self._epoch == epoch:
+                        log.debug("election failed: %s", e)
+                return
+            with self._lock:
+                if self._epoch != epoch:
+                    return
+                self._electing = False
+                self._leader = leader
+                if leader == self.rpc.get_name():
+                    self._synced = True
+                elif self._model_version >= version:
+                    self._synced = True
+                else:
+                    self._synced = self._set_state is None
+                log.info(
+                    "%s: leader=%s v%d (me v%d, synced=%s)",
+                    self.rpc.get_name(), leader, version,
+                    self._model_version, self._synced,
+                )
+
+        try:
+            fut = self.group.all_reduce(
+                "acc.elect", (self._model_version, self.rpc.get_name()),
+                op=_elect_max,
+            )
+        except RpcError:
+            self._electing = False
+            return
+        fut.add_done_callback(done)
+
+    # -- state sync -----------------------------------------------------------
+
+    def _serve_state(self):
+        """Leader-side state service (reference:
+        AccumulatorService::requestModel / modelUpdate)."""
+        if self._get_state is None:
+            raise RpcError("no get_state callback configured")
+        with self._lock:
+            # _model_version bumps when a reduced result becomes available,
+            # BEFORE the user applies it; the params get_state() sees then
+            # are still the previous version. Serve the version that matches
+            # the state actually handed out.
+            version = self._model_version - (1 if self._result is not None else 0)
+            state = _to_numpy_tree(self._get_state())
+        return {"state": state, "model_version": version}
+
+    def _maybe_request_state(self):
+        if self._state_req_inflight or self._set_state is None:
+            return
+        leader = self._leader
+        if leader is None or leader == self.rpc.get_name():
+            return
+        self._state_req_inflight = True
+        epoch = self._epoch
+
+        def on_state(result, error):
+            with self._lock:
+                self._state_req_inflight = False
+                if self._epoch != epoch:
+                    return
+                if error is not None:
+                    log.debug("state request failed: %s", error)
+                    return
+                version = result["model_version"]
+            # Apply outside the lock: user callback may be slow (device_put).
+            self._set_state(result["state"])
+            with self._lock:
+                if self._epoch == epoch:
+                    self._model_version = version
+                    self._synced = True
+                    log.info("%s: state synced at v%d",
+                             self.rpc.get_name(), version)
+
+        self.rpc.async_callback(
+            leader, "AccumulatorService::requestState", on_state
+        )
+
+    # -- reduce rounds ---------------------------------------------------------
+
+    def _start_count_round(self):
+        epoch = self._epoch
+        seq = self._seq
+        # Snapshot pending contributions for this round; they only commit if
+        # the round SUCCEEDS (a failed round's counts never reached the
+        # cluster, so its gradients must not enter a later grad round with
+        # an unreported sample count).
+        if self._synced and self._result is None:
+            snap_bundle = self._pending_bundle
+            snap_bs = self._pending_bs
+            snap_ng = self._pending_ngrads
+            self._pending_bundle = None
+            self._pending_bs = 0
+            self._pending_ngrads = 0
+        else:
+            snap_bundle, snap_bs, snap_ng = None, 0, 0
+        self._round_inflight = True
+
+        def restore_snapshot_locked():
+            self._pending_bundle = _tree_add(snap_bundle, self._pending_bundle)
+            self._pending_bs += snap_bs
+            self._pending_ngrads += snap_ng
+
+        def done(fut):
+            try:
+                total_bs, total_ng = fut.result(timeout=0)
+            except Exception:
+                with self._lock:
+                    restore_snapshot_locked()
+                    if self._epoch == epoch:
+                        self._round_inflight = False
+                        # Retry under a fresh key: parked partials from the
+                        # failed attempt must never merge into the retry.
+                        self._attempt += 1
+                return
+            with self._lock:
+                if self._epoch != epoch:
+                    # Success for a dead epoch: counts were discarded by the
+                    # reset, so re-contribute in the new epoch.
+                    restore_snapshot_locked()
+                    return
+                self._round_inflight = False
+                self._seq = seq + 1
+                self._committed_bundle = _tree_add(
+                    self._committed_bundle, snap_bundle
+                )
+                self._committed_bs += snap_bs
+                self._committed_ngrads += snap_ng
+                self._cumulative_bs += total_bs
+                if (
+                    self.virtual_batch_size
+                    <= self._cumulative_bs
+                ):
+                    self._start_grad_round(self._cumulative_bs)
+
+        try:
+            fut = self.group.all_reduce(
+                f"acc.count.{seq}.{self._attempt}", (snap_bs, snap_ng),
+                op=_count_merge,
+            )
+        except RpcError:
+            with self._lock:
+                restore_snapshot_locked()
+                self._round_inflight = False
+            return
+        fut.add_done_callback(done)
+
+    def _start_grad_round(self, count: int):
+        """All peers enter deterministically once counts cross the virtual
+        batch size (reference: startReduce, src/accumulator.cc:1005-1033)."""
+        epoch = self._epoch
+        gseq = self._gseq
+        bundle = self._committed_bundle
+        ngrads = self._committed_ngrads
+        self._committed_bundle = None
+        self._committed_bs = 0
+        self._committed_ngrads = 0
+        self._grad_inflight = True
+        self._cumulative_bs = 0
+
+        def done(fut):
+            try:
+                total_bundle, total_ng = fut.result(timeout=0)
+            except Exception as e:
+                with self._lock:
+                    if self._epoch == epoch:
+                        self._grad_inflight = False
+                        self._gseq = gseq + 1
+                        # Peers that completed this round applied an update we
+                        # missed: our params are now stale. Force a state
+                        # re-request from the leader instead of training on.
+                        if self._set_state is not None and not self.is_leader():
+                            self._synced = False
+                        log.debug("gradient round failed: %s", e)
+                return
+            with self._lock:
+                if self._epoch != epoch:
+                    return
+                self._grad_inflight = False
+                self._gseq = gseq + 1
+                if total_bundle is None:
+                    return  # nobody contributed
+                mean = nest.map_structure(
+                    lambda x: x / count, total_bundle
+                )
+                self._result = (mean, count)
+                self._model_version += 1
+
+        try:
+            fut = self.group.all_reduce(
+                f"acc.grads.{gseq}", (bundle, ngrads), op=_grad_merge
+            )
+        except RpcError:
+            self._grad_inflight = False
+            return
+        fut.add_done_callback(done)
+
+    # -- misc -----------------------------------------------------------------
+
+    def get_gradient_stats(self) -> dict:
+        with self._lock:
+            return {
+                "model_version": self._model_version,
+                "cumulative_batch_size": self._cumulative_bs,
+                "count_rounds": self._seq,
+                "gradient_rounds": self._gseq,
+                "leader": self._leader,
+                "synced": self._synced,
+            }
+
+    def close(self):
+        if self._owns_group:
+            self.group.close()

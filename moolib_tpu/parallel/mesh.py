@@ -1,0 +1,119 @@
+"""Device-mesh utilities: the intra-cohort (ICI) data plane.
+
+This is the TPU-native replacement for the reference's dense gradient path
+(reference: the pinned-CPU gradient bundles + software tree allreduce of
+src/accumulator.cc:880-1033 — on TPU those become XLA collectives over the
+ICI mesh inside the jitted train step, per the design note in SURVEY.md §5).
+
+Axis convention used across the framework:
+  - ``dp``: data parallel (gradient psum rides here)
+  - ``tp``: tensor/model parallel
+  - ``sp``: sequence/context parallel (ring attention)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "data_parallel_spec",
+    "replicated_spec",
+    "psum_gradients",
+    "pmean_gradients",
+    "dp_average_grads",
+    "shard_batch",
+]
+
+
+def make_mesh(
+    dp: Optional[int] = None,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dp, tp, sp) mesh over the available devices.
+
+    ``dp`` defaults to "whatever is left": n_devices // (tp * sp).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None:
+        if n % (tp * sp) != 0:
+            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
+        dp = n // (tp * sp)
+    if dp * tp * sp != n:
+        raise ValueError(
+            f"mesh {dp}x{tp}x{sp} needs {dp * tp * sp} devices, have {n}"
+        )
+    arr = np.asarray(devices).reshape(dp, tp, sp)
+    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+
+
+def data_parallel_spec() -> P:
+    """Batch-dim sharding over dp (time-major [T, B, ...]: shard axis 1)."""
+    return P(None, "dp")
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def shard_batch(mesh: Mesh, batch, batch_axis: int = 1):
+    """Place a host batch onto the mesh, sharded over dp along batch_axis."""
+
+    def _put(x):
+        spec = [None] * np.ndim(x)
+        if np.ndim(x) > batch_axis:
+            spec[batch_axis] = "dp"
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree_util.tree_map(_put, batch)
+
+
+def psum_gradients(grads, axis_name: str = "dp"):
+    """Sum *varying* values over a mesh axis — call INSIDE shard_map/jit.
+
+    NOTE (JAX >= 0.9 varying-axes semantics): ``jax.grad`` taken inside
+    shard_map w.r.t. a REPLICATED (unvarying) parameter already psums the
+    cotangent across the axis — the returned gradient is the global sum and
+    identical on every device. Calling psum/pmean on it again is wrong/
+    useless. Use :func:`dp_average_grads` for the canonical DP train step;
+    reserve this for genuinely per-device (varying) values such as metrics.
+    """
+    return jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), grads
+    )
+
+
+def pmean_gradients(grads, axis_name: str = "dp"):
+    """pmean of varying values (e.g. per-device losses/metrics)."""
+    return jax.tree_util.tree_map(
+        lambda g: jax.lax.pmean(g, axis_name), grads
+    )
+
+
+def dp_average_grads(grads, axis_name: str = "dp"):
+    """Convert auto-summed grads of a per-device-MEAN loss into global-mean
+    gradients: divide by the axis size.
+
+    The canonical data-parallel step on the ICI mesh (the XLA-native
+    replacement for the reference's gradient allreduce machinery,
+    src/accumulator.cc:1005-1033)::
+
+        def step(params, batch):           # inside shard_map
+            loss, grads = jax.value_and_grad(local_mean_loss)(params, batch)
+            grads = dp_average_grads(grads)        # global mean
+            loss = jax.lax.pmean(loss, "dp")       # varying -> mean
+            ...
+
+    ``jax.grad`` w.r.t. replicated params inside shard_map yields
+    sum_d grad(mean_loss_d) = n * grad(global_mean_loss); dividing by the
+    axis size recovers the global-mean gradient exactly.
+    """
+    n = jax.lax.psum(1, axis_name)
+    return jax.tree_util.tree_map(lambda g: g / n, grads)

@@ -1,0 +1,167 @@
+"""Elastic Accumulator tests: N peers + broker in one process
+(reference strategy: the reduce/membership tests of test/test_reduce.py
+applied to the Accumulator contract of src/moolib.cc:1645-1862)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu.parallel import Accumulator
+from test_group import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster()
+    yield c
+    c.close()
+
+
+def _spawn_acc(cluster, name, vbs, **kw):
+    rpc, g = cluster.spawn(name)
+    acc = Accumulator(rpc, group=g, virtual_batch_size=vbs, **kw)
+    return acc
+
+
+def _pump(accs, until, timeout=20.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for a in accs:
+            a.update()
+        if until():
+            return
+        time.sleep(interval)
+    raise TimeoutError("condition never reached; stats: "
+                       + str([a.get_gradient_stats() for a in accs]))
+
+
+def test_leader_election_and_connect(cluster):
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=4) for i in range(3)]
+    accs[1].set_model_version(10)  # p1 must win election
+    _pump(accs, lambda: all(a.connected() for a in accs))
+    leaders = {a.get_gradient_stats()["leader"] for a in accs}
+    assert leaders == {"p1"}
+    assert accs[1].is_leader() and not accs[0].is_leader()
+
+
+def test_gradient_reduction_virtual_batch(cluster):
+    n, vbs = 3, 6
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=vbs) for i in range(n)]
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients() for a in accs))
+
+    # Each peer contributes batch-sum grads for batch size 2: total 6 == vbs.
+    grads = [{"w": np.full((3,), float(i + 1)) * 2, "b": np.float64(i) * 2}
+             for i in range(n)]
+    for a, g in zip(accs, grads):
+        a.reduce_gradients(g, batch_size=2)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+
+    for a in accs:
+        mean, count = a.result_gradients()
+        assert count == vbs
+        # sum of batch-sums / 6: w = (1+2+3)*2/6 = 2.0
+        np.testing.assert_allclose(mean["w"], np.full((3,), 2.0))
+        np.testing.assert_allclose(mean["b"], (0 + 1 + 2) * 2 / 6)
+        assert a.model_version == accs[0].model_version
+    v0 = accs[0].model_version
+    for a in accs:
+        a.zero_gradients()
+        assert not a.has_gradients() and a.wants_gradients()
+    assert v0 >= 1
+
+
+def test_accumulation_across_rounds(cluster):
+    """vbs larger than one round's contributions: counts accumulate."""
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=8) for i in range(2)]
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients() for a in accs))
+    for step in range(2):  # 2 contributions of bs=2 each peer -> total 8
+        for i, a in enumerate(accs):
+            a.reduce_gradients({"g": np.ones(2) * (i + 1)}, batch_size=2)
+        if step == 0:
+            # mid-accumulation: not yet enough samples
+            time.sleep(0.2)
+            for a in accs:
+                a.update()
+            assert not any(a.has_gradients() for a in accs)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+    mean, count = accs[0].result_gradients()
+    assert count == 8
+    # total = 2*(1+2)*2 ones*... each peer: 2 rounds of ones*(i+1) * ... sum
+    # = 2*1 + 2*2 = 6 -> /8
+    np.testing.assert_allclose(mean["g"], np.full(2, 6 / 8))
+
+
+def test_skip_gradients_keeps_cluster_moving(cluster):
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=4) for i in range(3)]
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients() for a in accs))
+    # Only peer 0 trains; others skip — virtual batch fills from peer 0 alone.
+    accs[0].reduce_gradients({"g": np.ones(3) * 4}, batch_size=4)
+    accs[1].skip_gradients()
+    accs[2].skip_gradients()
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+    mean, count = accs[1].result_gradients()
+    assert count == 4
+    np.testing.assert_allclose(mean["g"], np.ones(3))
+
+
+def test_state_sync_to_joiner(cluster):
+    state = {"params": np.arange(4.0), "step": 7}
+    leader_acc = _spawn_acc(
+        cluster, "veteran", vbs=2,
+        get_state=lambda: state,
+    )
+    leader_acc.set_model_version(5)
+    _pump([leader_acc], lambda: leader_acc.connected())
+
+    received = {}
+    joiner = _spawn_acc(
+        cluster, "rookie", vbs=2,
+        set_state=lambda s: received.update(s),
+    )
+    accs = [leader_acc, joiner]
+    _pump(accs, lambda: joiner.connected()
+          and joiner.get_gradient_stats()["synced"])
+    np.testing.assert_array_equal(received["params"], state["params"])
+    assert received["step"] == 7
+    assert joiner.model_version == 5
+    assert leader_acc.is_leader() and not joiner.is_leader()
+
+
+def test_elastic_join_midstream(cluster):
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=2) for i in range(2)]
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients() for a in accs))
+    for a in accs:
+        a.reduce_gradients({"g": np.ones(1)}, batch_size=1)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+    for a in accs:
+        a.zero_gradients()
+    # New peer joins: resync epoch, re-election, cluster keeps reducing.
+    accs.append(_spawn_acc(cluster, "late", vbs=2))
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients() for a in accs))
+    for a in accs:
+        a.reduce_gradients({"g": np.ones(1)}, batch_size=1)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+    mean, count = accs[-1].result_gradients()
+    assert count >= 2
+
+
+def test_peer_death_recovery(cluster):
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=2) for i in range(3)]
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients() for a in accs))
+    # Kill one peer: its rpc dies, broker expires it, epoch resets, survivors
+    # keep reducing (reference: flagship elastic capability).
+    dead = accs.pop()
+    dead_rpc, dead_g = cluster.clients.pop()
+    dead_g.close()
+    dead_rpc.close()
+    _pump(accs, lambda: all(
+        a.connected() and len(a.group.members) == 2 for a in accs),
+        timeout=30)
+    for a in accs:
+        if a.wants_gradients():
+            a.reduce_gradients({"g": np.ones(1)}, batch_size=1)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs), timeout=30)
+    mean, count = accs[0].result_gradients()
+    assert count == 2
