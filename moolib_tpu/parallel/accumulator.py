@@ -449,7 +449,14 @@ class Accumulator:
                 f"acc.grads.{gseq}", (bundle, ngrads), op=_grad_merge
             )
         except RpcError:
+            # Mirror the async-failure path: peers whose round failed in
+            # flight advance to gseq+1, so a synchronous failure must too —
+            # otherwise this peer issues acc.grads.{gseq} keys one round
+            # behind the cluster for the rest of the epoch.
             self._grad_inflight = False
+            self._gseq = gseq + 1
+            if self._set_state is not None and not self.is_leader():
+                self._synced = False
             return
         fut.add_done_callback(done)
 

@@ -27,6 +27,8 @@ __all__ = [
     "pmean_gradients",
     "dp_average_grads",
     "shard_batch",
+    "batch_leaf_spec",
+    "batch_specs",
 ]
 
 
@@ -63,14 +65,60 @@ def replicated_spec() -> P:
     return P()
 
 
-def shard_batch(mesh: Mesh, batch, batch_axis: int = 1):
-    """Place a host batch onto the mesh, sharded over dp along batch_axis."""
+def batch_leaf_spec(x, batch_axis: int = 1, axis_name: str = "dp") -> P:
+    """PartitionSpec sharding ``batch_axis`` of one leaf over ``axis_name``;
+    leaves with too few dims (scalars, per-step vectors) replicate."""
+    nd = np.ndim(x)
+    if nd <= batch_axis:
+        return P()
+    spec = [None] * nd
+    spec[batch_axis] = axis_name
+    return P(*spec)
+
+
+def batch_specs(batch: dict, batch_axes: Optional[dict] = None,
+                axis_name: str = "dp") -> dict:
+    """Per-leaf PartitionSpecs for a learn-batch dict.
+
+    ``batch_axes`` maps top-level keys to the axis carrying the batch dim;
+    default is axis 1 (time-major [T, B, ...]) for everything except
+    ``core_state``, whose leaves are [B, ...] (axis 0).
+    """
+    axes = dict(batch_axes or {})
+    axes.setdefault("core_state", 0)
+    return {
+        k: jax.tree_util.tree_map(
+            lambda x, a=axes.get(k, 1): batch_leaf_spec(x, a, axis_name), v
+        )
+        for k, v in batch.items()
+    }
+
+
+def shard_batch(mesh: Mesh, batch, batch_axis: int = 1,
+                batch_axes: Optional[dict] = None):
+    """Place a host batch onto the mesh, sharded over dp along its batch axis.
+
+    For a top-level dict, per-key axes follow :func:`batch_specs` (so a
+    ``core_state`` entry shards on axis 0 automatically); any other pytree
+    shards every leaf on ``batch_axis``.
+    """
+    if isinstance(batch, dict):
+        axes = dict(batch_axes or {})
+        axes.setdefault("core_state", 0)
+        return {
+            k: jax.tree_util.tree_map(
+                lambda x, a=axes.get(k, 1): jax.device_put(
+                    x, NamedSharding(mesh, batch_leaf_spec(x, a))
+                ),
+                v,
+            )
+            for k, v in batch.items()
+        }
 
     def _put(x):
-        spec = [None] * np.ndim(x)
-        if np.ndim(x) > batch_axis:
-            spec[batch_axis] = "dp"
-        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        return jax.device_put(
+            x, NamedSharding(mesh, batch_leaf_spec(x, batch_axis))
+        )
 
     return jax.tree_util.tree_map(_put, batch)
 
