@@ -1,4 +1,5 @@
 from .accumulator import Accumulator
+from .stats import GlobalStatsAccumulator
 from .mesh import (
     data_parallel_spec,
     dp_average_grads,
@@ -11,6 +12,7 @@ from .mesh import (
 
 __all__ = [
     "Accumulator",
+    "GlobalStatsAccumulator",
     "make_mesh",
     "data_parallel_spec",
     "replicated_spec",

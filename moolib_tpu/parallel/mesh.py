@@ -77,21 +77,32 @@ def batch_leaf_spec(x, batch_axis: int = 1, axis_name: str = "dp") -> P:
 
 
 def batch_specs(batch: dict, batch_axes: Optional[dict] = None,
-                axis_name: str = "dp") -> dict:
+                axis_name: str = "dp", batch_axis: int = 1) -> dict:
     """Per-leaf PartitionSpecs for a learn-batch dict.
 
     ``batch_axes`` maps top-level keys to the axis carrying the batch dim;
-    default is axis 1 (time-major [T, B, ...]) for everything except
-    ``core_state``, whose leaves are [B, ...] (axis 0).
+    default is ``batch_axis`` (axis 1, time-major [T, B, ...]) for everything
+    except ``core_state``, whose leaves are [B, ...] (axis 0).
     """
-    axes = dict(batch_axes or {})
-    axes.setdefault("core_state", 0)
+    axes = _resolve_batch_axes(batch_axes, batch_axis)
     return {
         k: jax.tree_util.tree_map(
-            lambda x, a=axes.get(k, 1): batch_leaf_spec(x, a, axis_name), v
+            lambda x, a=axes.get(k, batch_axis): batch_leaf_spec(
+                x, a, axis_name
+            ),
+            v,
         )
         for k, v in batch.items()
     }
+
+
+def _resolve_batch_axes(batch_axes: Optional[dict], batch_axis: int) -> dict:
+    """Single source of truth for per-key batch axes, shared by
+    :func:`batch_specs` (jit in_specs) and :func:`shard_batch` (device_put)
+    so placements always match the step's in_shardings."""
+    axes = dict(batch_axes or {})
+    axes.setdefault("core_state", 0)
+    return axes
 
 
 def shard_batch(mesh: Mesh, batch, batch_axis: int = 1,
@@ -103,11 +114,10 @@ def shard_batch(mesh: Mesh, batch, batch_axis: int = 1,
     shards every leaf on ``batch_axis``.
     """
     if isinstance(batch, dict):
-        axes = dict(batch_axes or {})
-        axes.setdefault("core_state", 0)
+        axes = _resolve_batch_axes(batch_axes, batch_axis)
         return {
             k: jax.tree_util.tree_map(
-                lambda x, a=axes.get(k, 1): jax.device_put(
+                lambda x, a=axes.get(k, batch_axis): jax.device_put(
                     x, NamedSharding(mesh, batch_leaf_spec(x, a))
                 ),
                 v,

@@ -4,6 +4,7 @@ not pay JAX initialization cost (see moolib_tpu/__init__.py)."""
 
 import importlib
 
+from .checkpoint import Checkpointer, load_checkpoint, save_checkpoint
 from .logging import get_logger, set_log_level, set_logging
 from .stats import StatMax, StatMean, StatSum, Stats
 from .timer import Ewma, Timer
@@ -19,6 +20,9 @@ __all__ = [
     "Stats",
     "Ewma",
     "Timer",
+    "Checkpointer",
+    "save_checkpoint",
+    "load_checkpoint",
 ]
 
 

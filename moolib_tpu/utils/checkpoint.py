@@ -1,0 +1,126 @@
+"""Atomic checkpoint save/restore for pytrees of arrays.
+
+The reference keeps checkpointing at the example level: leader-only
+``torch.save`` of model/optimizer/scheduler/stats, atomic tmp+``os.replace``
+rename, versioned history copies, and resume that seeds
+``accumulator.set_model_version`` so the checkpoint holder wins leader
+election (reference: examples/vtrace/experiment.py:186-205,316-322,439-468).
+
+Here it is a library facility. JAX arrays are pulled to host as numpy (one
+``jax.device_get`` for the whole tree — a single batched D2H transfer) and
+written with pickle; restore returns numpy leaves that callers feed to
+``jax.device_put`` / their TrainState constructor. Works for arbitrary
+pytrees (params, optax states, plain dicts).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import time
+from typing import Any, Optional
+
+from .logging import get_logger
+
+log = get_logger("checkpoint")
+
+__all__ = ["save_checkpoint", "load_checkpoint", "Checkpointer"]
+
+_MAGIC = "moolib_tpu.checkpoint.v1"
+
+
+def _to_host(tree: Any) -> Any:
+    import jax
+
+    # One batched D2H transfer for the whole tree; non-array leaves pass
+    # through unchanged.
+    return jax.device_get(tree)
+
+
+def save_checkpoint(path: str, state: Any) -> None:
+    """Atomically write ``state`` (any picklable pytree; jax arrays are
+    device_get'd) to ``path`` via tmp + ``os.replace``."""
+    payload = {"magic": _MAGIC, "time": time.time(), "state": _to_host(state)}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".ckpt-", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            # mkstemp creates 0600 files; restore normal umask-governed perms
+            # so other processes (eval, serving) can read the checkpoint.
+            umask = os.umask(0)
+            os.umask(umask)
+            try:
+                os.fchmod(fd, 0o666 & ~umask)
+            except OSError:
+                pass  # some network/FUSE mounts refuse fchmod; keep 0600
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_checkpoint(path: str) -> Any:
+    """Read a checkpoint written by :func:`save_checkpoint`; returns the
+    state pytree with numpy leaves."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if not (isinstance(payload, dict) and payload.get("magic") == _MAGIC):
+        raise ValueError(f"{path} is not a moolib_tpu checkpoint")
+    return payload["state"]
+
+
+class Checkpointer:
+    """Periodic checkpointing with versioned history.
+
+    ``maybe_save`` is cheap to call every iteration; it writes at most every
+    ``interval`` seconds, always to the same ``path`` (atomic), plus an extra
+    immortal history copy every ``history_interval`` seconds (reference:
+    examples/vtrace/experiment.py:439-468 — checkpoint + checkpoint_history).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        interval: float = 600.0,
+        history_interval: Optional[float] = None,
+    ):
+        self.path = path
+        self.interval = interval
+        self.history_interval = history_interval
+        self._last_save = 0.0
+        self._last_history = time.time()
+
+    def maybe_save(self, state_fn, now: Optional[float] = None) -> bool:
+        """``state_fn`` is called only if a write is due (building the state
+        dict can be expensive — D2H transfers)."""
+        now = time.time() if now is None else now
+        if now - self._last_save < self.interval:
+            return False
+        self.save(state_fn() if callable(state_fn) else state_fn, now=now)
+        return True
+
+    def save(self, state: Any, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        save_checkpoint(self.path, state)
+        self._last_save = now
+        log.info("saved checkpoint to %s", self.path)
+        if (
+            self.history_interval is not None
+            and now - self._last_history >= self.history_interval
+        ):
+            base, ext = os.path.splitext(self.path)
+            hist = f"{base}-{int(now)}{ext or '.ckpt'}"
+            save_checkpoint(hist, state)
+            self._last_history = now
+            log.info("saved history checkpoint to %s", hist)
+
+    def load(self) -> Optional[Any]:
+        if not os.path.exists(self.path):
+            return None
+        return load_checkpoint(self.path)
