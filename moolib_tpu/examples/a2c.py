@@ -1,0 +1,361 @@
+"""Single-file A2C on CartPole with an in-process Broker + elastic Accumulator.
+
+Capability parity with the reference's A2C example (reference:
+examples/a2c.py — CartPole via gym, in-process Broker + Accumulator, rollout
+buffer, optional LSTM, per-rollout n-step-return policy-gradient updates),
+redesigned TPU-first:
+
+- acting and learning are jitted XLA computations (``make_act_step`` /
+  ``make_grad_step``); the rollout loop only moves numpy in and out of
+  :class:`moolib_tpu.EnvPool`'s shared-memory views;
+- the gradient update is split compute→reduce→apply around the elastic
+  :class:`moolib_tpu.Accumulator`, so extra peers can join the same broker
+  address at any time and the virtual batch fills from all of them
+  (run two copies of this script with ``--broker tcp://HOST:PORT`` to see it).
+
+Run: ``python -m moolib_tpu.examples.a2c [--total-steps N] [--use-lstm]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+import moolib_tpu
+from moolib_tpu.examples.common import EnvBatchState, StatMean, StatSum, Stats
+from moolib_tpu.examples.envs import create_cartpole
+
+__all__ = ["A2CConfig", "train", "a2c_loss"]
+
+
+@dataclasses.dataclass
+class A2CConfig:
+    """Defaults mirror the reference's constants (reference:
+    examples/a2c.py:17-27 — rollout 64, lr 1e-3, baseline cost 0.005,
+    entropy cost 0.0006, adam eps 3e-7)."""
+
+    total_steps: int = 50_000
+    unroll_length: int = 64
+    batch_size: int = 4  # envs per peer
+    num_processes: int = 2
+    num_batches: int = 2  # double buffering
+    use_lstm: bool = False
+    hidden_size: int = 64
+    learning_rate: float = 1e-3
+    adam_eps: float = 3e-7
+    discounting: float = 0.99
+    entropy_cost: float = 0.0006
+    baseline_cost: float = 0.005
+    grad_clip: float = 40.0
+    virtual_batch_size: Optional[int] = None  # default: one peer's batch
+    broker: Optional[str] = None  # None -> start an in-process broker
+    group: str = "a2c"
+    log_interval_steps: int = 4_000
+    seed: int = 0
+
+
+def a2c_loss(params, apply_fn, batch, config):
+    """A2C loss on a time-major unroll: n-step bootstrapped returns,
+    advantage policy gradient, baseline MSE, entropy bonus (reference:
+    examples/a2c.py loss math; ``config`` is an
+    :class:`moolib_tpu.learner.ImpalaConfig` so this plugs into
+    ``make_grad_step(loss_fn=...)``)."""
+    import jax
+    import jax.numpy as jnp
+
+    (logits, baseline), _ = apply_fn(
+        params, batch["obs"], batch["done"], batch["core_state"]
+    )
+    logits_t = logits[:-1]
+    baseline_t = baseline[:-1]
+    bootstrap = jax.lax.stop_gradient(baseline[-1])
+
+    rewards = batch["rewards"][1:]
+    if config.reward_clip > 0:
+        rewards = jnp.clip(rewards, -config.reward_clip, config.reward_clip)
+    discounts = (~batch["done"][1:]).astype(jnp.float32) * config.discounting
+
+    def back(ret, rd):
+        r, d = rd
+        ret = r + d * ret
+        return ret, ret
+
+    _, returns = jax.lax.scan(
+        back, bootstrap, (rewards, discounts), reverse=True
+    )
+    adv = jax.lax.stop_gradient(returns - baseline_t)
+
+    logp = jax.nn.log_softmax(logits_t, axis=-1)
+    action_logp = jnp.take_along_axis(
+        logp, batch["actions"][..., None], axis=-1
+    ).squeeze(-1)
+    pg_loss = -jnp.mean(action_logp * adv)
+    baseline_loss = 0.5 * jnp.mean(
+        (jax.lax.stop_gradient(returns) - baseline_t) ** 2
+    )
+    p = jnp.exp(logp)
+    entropy = -jnp.mean(jnp.sum(p * logp, axis=-1))
+
+    total = (
+        pg_loss
+        + config.baseline_cost * baseline_loss
+        - config.entropy_cost * entropy
+    )
+    metrics = {
+        "total_loss": total,
+        "pg_loss": pg_loss,
+        "baseline_loss": baseline_loss,
+        "entropy": entropy,
+        "mean_baseline": jnp.mean(baseline_t),
+    }
+    return total, metrics
+
+
+class _InProcessBroker:
+    """Broker on a background thread (reference: a2c example starts its own
+    Broker in-process, examples/a2c.py:268-275)."""
+
+    def __init__(self):
+        from moolib_tpu.rpc.broker import Broker
+
+        self.rpc = moolib_tpu.Rpc("broker")
+        self.rpc.listen("127.0.0.1:0")
+        self.address = self.rpc.debug_info()["listen"][0]
+        self._broker = Broker(self.rpc)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._broker.update()
+            time.sleep(0.05)
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.rpc.close()
+
+
+def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
+    """Train A2C on CartPole; returns the list of logged stat rows."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from moolib_tpu.learner import (
+        ImpalaConfig,
+        make_act_step,
+        make_apply_step,
+        make_grad_step,
+        make_train_state,
+    )
+    from moolib_tpu.models import A2CNet
+
+    broker = None
+    broker_addr = cfg.broker
+    if broker_addr is None:
+        broker = _InProcessBroker()
+        broker_addr = broker.address
+
+    rpc = moolib_tpu.Rpc(f"a2c-{moolib_tpu.create_uid()[:8]}")
+    rpc.listen("127.0.0.1:0")
+    rpc.connect(broker_addr)
+
+    net = A2CNet(
+        num_actions=2,
+        hidden_sizes=(cfg.hidden_size, cfg.hidden_size),
+        use_lstm=cfg.use_lstm,
+        lstm_size=cfg.hidden_size,
+    )
+    rng = jax.random.PRNGKey(cfg.seed)
+    rng, init_rng = jax.random.split(rng)
+    dummy_obs = jnp.zeros((1, 1, 4), jnp.float32)
+    dummy_done = jnp.zeros((1, 1), bool)
+    params = net.init(init_rng, dummy_obs, dummy_done, net.initial_state(1))
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adam(cfg.learning_rate, eps=cfg.adam_eps),
+    )
+    state = make_train_state(params, optimizer)
+
+    loss_cfg = ImpalaConfig(
+        discounting=cfg.discounting,
+        baseline_cost=cfg.baseline_cost,
+        entropy_cost=cfg.entropy_cost,
+        reward_clip=0.0,
+    )
+    act = make_act_step(net.apply)
+    grad_step = make_grad_step(net.apply, config=loss_cfg, loss_fn=a2c_loss)
+    apply_step = make_apply_step(optimizer, donate=False)
+
+    def get_state():
+        return {
+            "state": jax.device_get(state),
+            "model_version": accumulator.model_version,
+        }
+
+    def set_state(payload):
+        nonlocal state
+        state = jax.tree_util.tree_map(jnp.asarray, payload["state"])
+
+    accumulator = moolib_tpu.Accumulator(
+        rpc,
+        group_name=cfg.group,
+        virtual_batch_size=cfg.virtual_batch_size or cfg.batch_size,
+        get_state=get_state,
+        set_state=set_state,
+    )
+
+    pool = moolib_tpu.EnvPool(
+        create_cartpole,
+        num_processes=cfg.num_processes,
+        batch_size=cfg.batch_size,
+        num_batches=cfg.num_batches,
+        action_dtype=np.int64,
+    )
+
+    stats = Stats(
+        env_steps=StatSum(),
+        updates=StatSum(),
+        skips=StatSum(),
+        dropped_unrolls=StatSum(),
+        mean_episode_return=StatMean(),
+        total_loss=StatMean(),
+        entropy=StatMean(),
+    )
+    logs: List[dict] = []
+
+    batch_states = [
+        EnvBatchState(cfg.unroll_length, net.initial_state(cfg.batch_size))
+        for _ in range(cfg.num_batches)
+    ]
+    actions = [
+        np.zeros(cfg.batch_size, np.int64) for _ in range(cfg.num_batches)
+    ]
+    pending_unrolls: List[dict] = []
+    env_steps = 0
+    next_log = cfg.log_interval_steps
+    futures = [pool.step(i, actions[i]) for i in range(cfg.num_batches)]
+
+    try:
+        while env_steps < cfg.total_steps:
+            for i in range(cfg.num_batches):
+                out = futures[i].result()
+                bs = batch_states[i]
+                unroll = bs.observe(out)
+                if unroll is not None:
+                    pending_unrolls.append(unroll)
+                    # Backpressure: never queue stale rollouts without bound
+                    # while disconnected or the learner lags.
+                    while len(pending_unrolls) > 4:
+                        pending_unrolls.pop(0)
+                        stats["dropped_unrolls"] += 1
+                rng, act_rng = jax.random.split(rng)
+                a, logits, core = act(
+                    state.params,
+                    act_rng,
+                    jnp.asarray(out["obs"]),
+                    jnp.asarray(out["done"]),
+                    bs.core_state,
+                )
+                a = np.asarray(a)
+                bs.record_action(a, np.asarray(logits), core)
+                actions[i][:] = a
+                futures[i] = pool.step(i, actions[i])
+                env_steps += cfg.batch_size
+                stats["env_steps"] += cfg.batch_size
+
+            accumulator.update()
+            if accumulator.connected():
+                if accumulator.wants_gradients():
+                    if pending_unrolls:
+                        unroll = pending_unrolls.pop(0)
+                        batch = {
+                            k: jnp.asarray(v) if not isinstance(v, tuple) else v
+                            for k, v in unroll.items()
+                        }
+                        grads, metrics = grad_step(state.params, batch)
+                        stats["total_loss"] += float(metrics["total_loss"])
+                        stats["entropy"] += float(metrics["entropy"])
+                        # grad_step returns batch-mean grads; the Accumulator
+                        # contract is batch-sum (src/accumulator.cc:880-1003).
+                        b = cfg.batch_size
+                        grad_sum = jax.tree_util.tree_map(
+                            lambda g: np.asarray(g) * b, grads
+                        )
+                        accumulator.reduce_gradients(grad_sum, batch_size=b)
+                    else:
+                        accumulator.skip_gradients()
+                        stats["skips"] += 1
+                if accumulator.has_gradients():
+                    mean_grads, _count = accumulator.result_gradients()
+                    state = apply_step(
+                        state,
+                        jax.tree_util.tree_map(jnp.asarray, mean_grads),
+                    )
+                    accumulator.zero_gradients()
+                    stats["updates"] += 1
+
+            for bs in batch_states:
+                for r in bs.recent_returns():
+                    stats["mean_episode_return"] += r
+
+            if env_steps >= next_log:
+                next_log += cfg.log_interval_steps
+                row = dict(stats.results(), env_steps=env_steps,
+                           model_version=accumulator.model_version)
+                logs.append(row)
+                log_fn(
+                    "steps {env_steps:>8}  return {mean_episode_return:7.2f}  "
+                    "loss {total_loss:8.4f}  entropy {entropy:6.3f}  "
+                    "updates {updates:g}".format(**row)
+                )
+                stats["mean_episode_return"].reset()
+                stats["total_loss"].reset()
+                stats["entropy"].reset()
+    finally:
+        pool.close()
+        accumulator.close()
+        rpc.close()
+        if broker is not None:
+            broker.close()
+    return logs
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--total-steps", type=int, default=A2CConfig.total_steps)
+    p.add_argument("--batch-size", type=int, default=A2CConfig.batch_size)
+    p.add_argument("--unroll-length", type=int,
+                   default=A2CConfig.unroll_length)
+    p.add_argument("--num-processes", type=int,
+                   default=A2CConfig.num_processes)
+    p.add_argument("--learning-rate", type=float,
+                   default=A2CConfig.learning_rate)
+    p.add_argument("--use-lstm", action="store_true")
+    p.add_argument("--broker", type=str, default=None,
+                   help="tcp://HOST:PORT of a running broker; default starts "
+                        "one in-process")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    cfg = A2CConfig(
+        total_steps=args.total_steps,
+        batch_size=args.batch_size,
+        unroll_length=args.unroll_length,
+        num_processes=args.num_processes,
+        learning_rate=args.learning_rate,
+        use_lstm=args.use_lstm,
+        broker=args.broker,
+        seed=args.seed,
+    )
+    train(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""Rollout bookkeeping shared by the examples.
+
+Capability parity with the reference's ``examples/common``
+(reference: examples/common/__init__.py — StatMean/StatSum, EnvBatchState
+per-batch RNN-state/reward bookkeeping + time batching at :154-207; the
+cluster-wide stats accumulator now lives in the library proper,
+:mod:`moolib_tpu.parallel.stats`).
+
+``EnvBatchState`` turns a stream of per-step EnvPool outputs + actions into
+time-major learn-unrolls of the layout the learner expects
+(:func:`moolib_tpu.learner.impala_loss` batch contract): frames overlap by
+one step so frame T of one unroll is frame 0 of the next, giving every
+unroll its bootstrap frame for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from moolib_tpu.utils import StatMax, StatMean, StatSum, Stats
+from moolib_tpu.utils import nest  # noqa: F401  (re-export)
+
+__all__ = [
+    "EnvBatchState",
+    "StatMean",
+    "StatSum",
+    "StatMax",
+    "Stats",
+    "nest",
+]
+
+
+class EnvBatchState:
+    """Per-EnvPool-batch rollout state: RNN core state, frame/action buffers,
+    episode-return tracking.
+
+    Protocol, once per pool step (one `i` of the double buffer)::
+
+        out = pool.step(i, actions).result()       # frame t arrives
+        unroll = state.observe(out)                # may complete an unroll
+        if unroll is not None: learn_batcher.cat(unroll)
+        a, logits, core = act(params, rng, out["obs"], out["done"], state.core_state)
+        state.record_action(a, logits, core)
+        actions = a
+    """
+
+    def __init__(self, unroll_length: int, initial_core_state: Any):
+        self.T = unroll_length
+        self.core_state = initial_core_state  # state at the newest frame
+        self._unroll_start_state = initial_core_state  # state at buffered frame 0
+        self._frames: List[Dict[str, np.ndarray]] = []
+        self._actions: List[np.ndarray] = []
+        self._logits: List[np.ndarray] = []
+        # Episode stats harvested from done transitions, drained by
+        # recent_returns()/recent_lengths().
+        self._completed_returns: List[float] = []
+        self._completed_lengths: List[float] = []
+
+    def observe(self, env_out: Dict[str, np.ndarray]) -> Optional[Dict]:
+        """Feed one EnvPool output dict (frame t); returns a completed
+        time-major unroll every ``unroll_length`` frames, else None."""
+        done = np.asarray(env_out["done"])
+        if done.any():
+            rets = np.asarray(env_out["episode_return"])[done]
+            steps = np.asarray(env_out["episode_step"])[done]
+            self._completed_returns.extend(float(r) for r in rets)
+            self._completed_lengths.extend(float(s) for s in steps)
+        obs_keys = [
+            k
+            for k in env_out
+            if k
+            not in ("action", "reward", "done", "episode_step", "episode_return")
+        ]
+        obs = (
+            env_out[obs_keys[0]]
+            if obs_keys == ["obs"]
+            else {k: env_out[k] for k in obs_keys}
+        )
+        # Copy: EnvPool returns zero-copy views over shared memory that the
+        # next step into this buffer will overwrite.
+        frame = {
+            "obs": nest.map_structure(np.array, obs),
+            "done": np.array(done),
+            "rewards": np.asarray(env_out["reward"], np.float32).copy(),
+        }
+        self._frames.append(frame)
+        if len(self._frames) < self.T + 1:
+            return None
+        assert len(self._actions) == self.T, (
+            f"{len(self._actions)} actions for {len(self._frames)} frames"
+        )
+        unroll = {
+            "obs": nest.map_structure(
+                lambda *xs: np.stack(xs), *[f["obs"] for f in self._frames]
+            ),
+            "done": np.stack([f["done"] for f in self._frames]),
+            "rewards": np.stack([f["rewards"] for f in self._frames]),
+            "actions": np.stack(self._actions).astype(np.int32),
+            "behavior_logits": np.stack(self._logits),
+            "core_state": self._unroll_start_state,
+        }
+        # Frame T becomes frame 0 of the next unroll (bootstrap overlap).
+        self._frames = [self._frames[-1]]
+        self._actions = []
+        self._logits = []
+        self._unroll_start_state = self.core_state
+        return unroll
+
+    def record_action(self, action, behavior_logits, new_core_state=None):
+        """Record the action taken at the newest frame (and the core state
+        that acting produced, which belongs to the *next* frame)."""
+        self._actions.append(np.asarray(action))
+        self._logits.append(np.asarray(behavior_logits, np.float32))
+        if new_core_state is not None:
+            self.core_state = new_core_state
+
+    def recent_returns(self, clear: bool = True) -> List[float]:
+        out = self._completed_returns
+        if clear:
+            self._completed_returns = []
+        return out
+
+    def recent_lengths(self, clear: bool = True) -> List[float]:
+        out = self._completed_lengths
+        if clear:
+            self._completed_lengths = []
+        return out

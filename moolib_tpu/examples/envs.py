@@ -1,0 +1,220 @@
+"""Environment factories for the examples.
+
+The reference's env layer is gym CartPole for A2C (reference:
+examples/a2c.py:26-45) and an ALE Atari stack with seed_rl-style
+preprocessing + frame stack for IMPALA (reference:
+examples/atari/{environment,atari_preprocessing}.py). Here:
+
+- :class:`CartPole` — the classic cart-pole dynamics implemented directly in
+  numpy so the examples and integration tests run with zero external env
+  dependencies; gymnasium is used instead when present (same observation/
+  action/reward contract).
+- :class:`SyntheticAtari` — an Atari-*shaped* pixel env (84x84x4 uint8,
+  discrete actions) with a learnable cue→action signal, for exercising and
+  benchmarking the full pixel pipeline on machines without ALE ROMs.
+- :func:`create_atari` — the real ALE path (gated on ale_py being
+  installed), with gymnasium's AtariPreprocessing (noop starts before
+  frameskip, like seed_rl) and 4-frame stacking.
+
+This module must stay import-light (numpy only, gymnasium lazily): EnvPool
+workers import it on spawn, and worker startup cost is pool startup cost.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+__all__ = [
+    "CartPole",
+    "SyntheticAtari",
+    "create_cartpole",
+    "create_synthetic_atari",
+    "create_atari",
+]
+
+
+class CartPole:
+    """CartPole-v1 dynamics (Barto-Sutton-Anderson), gymnasium-compatible API.
+
+    Physics constants and termination bounds match gymnasium's CartPole-v1 so
+    the built-in fallback and the gymnasium path are interchangeable.
+    """
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    LENGTH = 0.5  # half pole length
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * math.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros(4, np.float64)
+        self._steps = 0
+        self._needs_reset = True
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._steps = 0
+        self._needs_reset = False
+        return self._state.astype(np.float32), {}
+
+    def step(self, action):
+        if self._needs_reset:
+            raise RuntimeError("step() called before reset()")
+        x, x_dot, theta, theta_dot = self._state
+        force = self.FORCE_MAG if action == 1 else -self.FORCE_MAG
+        costheta, sintheta = math.cos(theta), math.sin(theta)
+        total_mass = self.MASSCART + self.MASSPOLE
+        polemass_length = self.MASSPOLE * self.LENGTH
+
+        temp = (
+            force + polemass_length * theta_dot**2 * sintheta
+        ) / total_mass
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH
+            * (4.0 / 3.0 - self.MASSPOLE * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * xacc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * thetaacc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._steps += 1
+
+        terminated = bool(
+            abs(x) > self.X_LIMIT or abs(theta) > self.THETA_LIMIT
+        )
+        truncated = self._steps >= self.MAX_STEPS
+        self._needs_reset = terminated or truncated
+        return (
+            self._state.astype(np.float32),
+            1.0,
+            terminated,
+            truncated,
+            {},
+        )
+
+
+class SyntheticAtari:
+    """Atari-shaped pixel env with a learnable signal.
+
+    Observation: [84, 84, C] uint8. A cue patch in the top-left corner
+    encodes which of ``num_actions`` actions yields reward +1 this step
+    (wrong actions yield 0); the rest of the frame is procedural noise that
+    scrolls with the episode step, so the policy must read the cue, not
+    memorize frames. Episodes end after ``episode_length`` steps. Optimal
+    mean reward per step is 1.0; a uniform policy gets 1/num_actions.
+    """
+
+    def __init__(
+        self,
+        num_actions: int = 6,
+        channels: int = 4,
+        size: int = 84,
+        episode_length: int = 200,
+        seed: Optional[int] = None,
+    ):
+        self.num_actions = num_actions
+        self.channels = channels
+        self.size = size
+        self.episode_length = episode_length
+        self._rng = np.random.default_rng(seed)
+        # Fixed noise bank; frames index into it so stepping is cheap.
+        self._noise = self._rng.integers(
+            0, 255, size=(8, size, size, channels), dtype=np.uint8
+        )
+        self._cue = 0
+        self._steps = 0
+
+    def _obs(self) -> np.ndarray:
+        frame = self._noise[self._steps % len(self._noise)].copy()
+        # Cue patch: rows 0-7, one 8-wide column band per action, all channels.
+        frame[:8, :, :] = 0
+        c0 = self._cue * 8
+        frame[:8, c0 : c0 + 8, :] = 255
+        return frame
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._steps = 0
+        self._cue = int(self._rng.integers(self.num_actions))
+        return self._obs(), {}
+
+    def step(self, action):
+        reward = 1.0 if int(action) == self._cue else 0.0
+        self._steps += 1
+        self._cue = int(self._rng.integers(self.num_actions))
+        terminated = False
+        truncated = self._steps >= self.episode_length
+        return self._obs(), reward, terminated, truncated, {}
+
+
+def create_cartpole(index: int = 0, prefer_gymnasium: bool = True):
+    """CartPole factory for EnvPool (picklable, per-env seeding by index)."""
+    if prefer_gymnasium:
+        try:
+            import gymnasium
+
+            env = gymnasium.make("CartPole-v1")
+            env.reset(seed=index)
+            return env
+        except Exception:
+            pass
+    return CartPole(seed=index)
+
+
+def create_synthetic_atari(
+    index: int = 0, num_actions: int = 6, episode_length: int = 200
+):
+    return SyntheticAtari(
+        num_actions=num_actions, episode_length=episode_length, seed=index
+    )
+
+
+def create_atari(
+    game: str = "ALE/Breakout-v5",
+    index: int = 0,
+    frame_stack: int = 4,
+    noop_max: int = 30,
+):
+    """Real ALE Atari with seed_rl-style preprocessing (reference:
+    examples/atari/environment.py + atari_preprocessing.py — noops applied
+    before frameskip, grayscale 84x84, 4-frame stack). Requires ale_py."""
+    try:
+        import ale_py  # noqa: F401
+        import gymnasium
+        from gymnasium.wrappers import AtariPreprocessing
+    except ImportError as e:
+        raise ImportError(
+            "create_atari requires gymnasium + ale_py (ALE ROMs); use "
+            "create_synthetic_atari for an Atari-shaped env without them"
+        ) from e
+    env = gymnasium.make(game, frameskip=1)
+    env = AtariPreprocessing(
+        env, noop_max=noop_max, frame_skip=4, screen_size=84
+    )
+    try:
+        from gymnasium.wrappers import FrameStackObservation
+
+        env = FrameStackObservation(env, frame_stack)
+    except ImportError:  # older gymnasium
+        from gymnasium.wrappers import FrameStack
+
+        env = FrameStack(env, frame_stack)
+    env.reset(seed=index)
+    return env

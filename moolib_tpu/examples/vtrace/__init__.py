@@ -1,0 +1,1 @@
+from .experiment import VtraceConfig, train  # noqa: F401

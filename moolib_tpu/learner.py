@@ -40,6 +40,8 @@ __all__ = [
     "make_train_state",
     "impala_loss",
     "make_impala_train_step",
+    "make_grad_step",
+    "make_apply_step",
     "make_act_step",
 ]
 
@@ -210,6 +212,80 @@ def make_impala_train_step(
         )(state, batch)
 
     return jax.jit(sharded_step, donate_argnums=(0,) if donate else ())
+
+
+def make_grad_step(
+    apply_fn: Callable,
+    config: ImpalaConfig = ImpalaConfig(),
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "dp",
+    loss_fn: Callable = impala_loss,
+    batch_axes: Optional[dict] = None,
+) -> Callable[[Any, dict], Tuple[Any, dict]]:
+    """Build the jitted gradient step ``(params, batch) -> (grads, metrics)``.
+
+    This is the compute half of the elastic path: the Accumulator mediates
+    between gradient computation and the optimizer step (reference:
+    compute_gradients → accumulator.reduce_gradients → opt.step,
+    examples/vtrace/experiment.py:470-529), so grads must surface to the
+    host. With a ``mesh`` the local dp-mean rides ICI inside the step; the
+    Accumulator then handles the cross-cohort (DCN) reduction.
+    """
+
+    def local_loss(params, batch):
+        return loss_fn(params, apply_fn, batch, config)
+
+    if mesh is None:
+
+        def step(params, batch):
+            (_, metrics), grads = jax.value_and_grad(
+                local_loss, has_aux=True
+            )(params, batch)
+            metrics = dict(metrics)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return grads, metrics
+
+        return jax.jit(step)
+
+    replicated = P()
+
+    def sharded_step(params, batch):
+        def inner(params, batch):
+            (_, metrics), grads = jax.value_and_grad(
+                local_loss, has_aux=True
+            )(params, batch)
+            grads = dp_average_grads(grads, axis_name)
+            metrics = jax.tree_util.tree_map(
+                lambda m: jax.lax.pmean(m, axis_name), metrics
+            )
+            metrics = dict(metrics)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return grads, metrics
+
+        return jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(replicated, batch_specs(batch, batch_axes, axis_name)),
+            out_specs=(replicated, replicated),
+        )(params, batch)
+
+    return jax.jit(sharded_step)
+
+
+def make_apply_step(
+    optimizer: optax.GradientTransformation, donate: bool = True
+) -> Callable[[TrainState, Any], TrainState]:
+    """Build the jitted optimizer-apply step ``(state, grads) -> state`` for
+    externally-reduced gradients (the other half of :func:`make_grad_step`)."""
+
+    def apply(state: TrainState, grads):
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1)
+
+    return jax.jit(apply, donate_argnums=(0,) if donate else ())
 
 
 def make_act_step(apply_fn: Callable, temperature: float = 1.0):

@@ -46,12 +46,17 @@ class Batcher:
         batch_size: int,
         device: Optional[Any] = None,
         dim: int = 0,
+        dims: Optional[dict] = None,
     ):
+        """``dims`` maps top-level dict keys to a per-key batch axis
+        overriding ``dim`` — e.g. learn-unrolls are [T, B, ...] (dim=1) but
+        their ``core_state`` leaves are [B, ...] (dims={'core_state': 0})."""
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
         self.batch_size = batch_size
         self.device = device
         self.dim = dim
+        self.dims = dict(dims) if dims else None
         self._lock = threading.Condition()
         self._pending_stack: list = []  # items awaiting a full stack batch
         self._pending_cat: list = []  # trees awaiting cat; rows counted below
@@ -75,21 +80,27 @@ class Batcher:
             slot = _Slot()
             self._ready.append(slot)
         # Assemble + stage outside the lock.
-        batch = self._stage(nest.stack_fields(items, axis=self.dim))
+        batch = self._stage(self._stack_trees(items))
         self._fill(slot, batch)
 
     def cat(self, tree: Any) -> None:
         """Add an already-batched structure; splits/carries past batch_size."""
         with self._lock:
             self._check_open()
-            leaves, treedef = jax.tree_util.tree_flatten(tree)
-            rows = leaves[0].shape[self.dim]
-            for leaf in leaves:
-                if leaf.shape[self.dim] != rows:
-                    raise ValueError(
-                        f"inconsistent batch axis in cat(): "
-                        f"{leaf.shape[self.dim]} != {rows}"
-                    )
+            treedef = jax.tree_util.tree_structure(tree)
+            rows = None
+            for key, sub in self._keyed(tree):
+                ax = self._axis_for(key)
+                for leaf in jax.tree_util.tree_leaves(sub):
+                    r = leaf.shape[ax]
+                    if rows is None:
+                        rows = r
+                    elif r != rows:
+                        raise ValueError(
+                            f"inconsistent batch axis in cat(): {r} != {rows}"
+                        )
+            if rows is None:
+                raise ValueError("cat() of an empty structure")
             if self._pending_cat:
                 prev = jax.tree_util.tree_structure(self._pending_cat[0])
                 if treedef != prev:
@@ -102,23 +113,20 @@ class Batcher:
                 return
             # One merge, then all full-batch slices in a single pass.
             merged = (
-                nest.cat_fields(self._pending_cat, axis=self.dim)
+                self._cat_trees(self._pending_cat)
                 if len(self._pending_cat) > 1
                 else self._pending_cat[0]
             )
             total = self._pending_cat_rows
             n_full, remainder = divmod(total, self.batch_size)
             raws = [
-                nest.slice_fields(
-                    merged,
-                    i * self.batch_size,
-                    (i + 1) * self.batch_size,
-                    self.dim,
+                self._slice_tree(
+                    merged, i * self.batch_size, (i + 1) * self.batch_size
                 )
                 for i in range(n_full)
             ]
             if remainder:
-                rest = nest.slice_fields(merged, total - remainder, total, self.dim)
+                rest = self._slice_tree(merged, total - remainder, total)
                 # Copy: a view would pin the whole merged buffer in memory.
                 self._pending_cat = [
                     jax.tree_util.tree_map(
@@ -141,6 +149,12 @@ class Batcher:
         """True when no completed batch is ready (reference get/empty contract)."""
         with self._lock:
             return not (self._ready and self._ready[0].done)
+
+    def ready(self) -> int:
+        """Number of completed batches waiting to be consumed — lets callers
+        apply backpressure (drop/skip) instead of queueing unboundedly."""
+        with self._lock:
+            return sum(1 for s in self._ready if s.done)
 
     def get(self, timeout: Optional[float] = None) -> Any:
         """Block until a completed batch is available and return it.
@@ -168,6 +182,47 @@ class Batcher:
     def _check_open(self):
         if self._closed:
             raise RuntimeError("Batcher is closed")
+
+    # Per-key batch-axis plumbing (dims=): a top-level dict key may carry its
+    # batch dimension on a different axis than self.dim.
+
+    def _axis_for(self, key) -> int:
+        if key is None or not self.dims:
+            return self.dim
+        return self.dims.get(key, self.dim)
+
+    def _keyed(self, tree):
+        if self.dims and isinstance(tree, dict):
+            return list(tree.items())
+        return [(None, tree)]
+
+    def _stack_trees(self, items):
+        if self.dims and isinstance(items[0], dict):
+            return {
+                k: nest.stack_fields(
+                    [it[k] for it in items], axis=self._axis_for(k)
+                )
+                for k in items[0]
+            }
+        return nest.stack_fields(items, axis=self.dim)
+
+    def _cat_trees(self, trees):
+        if self.dims and isinstance(trees[0], dict):
+            return {
+                k: nest.cat_fields(
+                    [t[k] for t in trees], axis=self._axis_for(k)
+                )
+                for k in trees[0]
+            }
+        return nest.cat_fields(trees, axis=self.dim)
+
+    def _slice_tree(self, tree, start, stop):
+        if self.dims and isinstance(tree, dict):
+            return {
+                k: nest.slice_fields(v, start, stop, self._axis_for(k))
+                for k, v in tree.items()
+            }
+        return nest.slice_fields(tree, start, stop, self.dim)
 
     def _fill(self, slot: "_Slot", batch: Any) -> None:
         with self._lock:

@@ -128,6 +128,7 @@ class Accumulator:
         self._committed_ngrads = 0
 
         self._result: Optional[Tuple[Any, int]] = None  # (mean grads, count)
+        self._result_version = 0  # model version the latest result produces
         self._user_has_contributed = False
 
         rpc.define(
@@ -145,6 +146,7 @@ class Accumulator:
         (reference: src/moolib.cc:1808-1821)."""
         with self._lock:
             self._model_version = int(v)
+            self._result_version = int(v)
 
     def is_leader(self) -> bool:
         return self._leader == self.rpc.get_name()
@@ -170,6 +172,14 @@ class Accumulator:
             if self._result is None:
                 raise RpcError("no reduced gradients available")
             return self._result
+
+    def result_model_version(self) -> int:
+        """Model version that applying the current (or most recent) reduced
+        gradients produces. Unlike ``model_version`` this does not advance
+        concurrently between ``has_gradients()`` and a later read, so it is
+        the right label for checkpoints of just-updated params."""
+        with self._lock:
+            return self._result_version
 
     # -- user contributions ---------------------------------------------------
 
@@ -326,6 +336,7 @@ class Accumulator:
             with self._lock:
                 if self._epoch == epoch:
                     self._model_version = version
+                    self._result_version = version
                     self._synced = True
                     log.info("%s: state synced at v%d",
                              self.rpc.get_name(), version)
@@ -370,6 +381,9 @@ class Accumulator:
                         # Retry under a fresh key: parked partials from the
                         # failed attempt must never merge into the retry.
                         self._attempt += 1
+                        # The user answered this round's poll; re-open the
+                        # wants_gradients window for the retry.
+                        self._user_has_contributed = False
                 return
             with self._lock:
                 if self._epoch != epoch:
@@ -379,6 +393,12 @@ class Accumulator:
                     return
                 self._round_inflight = False
                 self._seq = seq + 1
+                # A count round resolved the current wants_gradients poll;
+                # peers may contribute again toward the (still unfilled)
+                # virtual batch — all-skip cycles must not livelock
+                # (reference: wantsGradients re-arms each cycle,
+                # src/moolib.cc:1645-1862).
+                self._user_has_contributed = False
                 self._committed_bundle = _tree_add(
                     self._committed_bundle, snap_bundle
                 )
@@ -443,6 +463,10 @@ class Accumulator:
                 )
                 self._result = (mean, count)
                 self._model_version += 1
+                # Version of the params a user will hold AFTER applying this
+                # result — lets callers label checkpoints race-free while
+                # _model_version keeps moving on RPC threads.
+                self._result_version = self._model_version
 
         try:
             fut = self.group.all_reduce(

@@ -93,3 +93,48 @@ def test_device_placement(rng):
 def test_bad_batch_size():
     with pytest.raises(ValueError):
         Batcher(batch_size=0)
+
+
+def test_cat_per_key_dims(rng):
+    """dims= lets core_state ([B, ...]) ride along [T, B, ...] unrolls."""
+    T, b = 5, 4
+    mk = lambda: {
+        "obs": rng.standard_normal((T, b, 3)).astype(np.float32),
+        "core_state": (rng.standard_normal((b, 7)).astype(np.float32),),
+    }
+    batcher = Batcher(batch_size=8, dim=1, dims={"core_state": 0})
+    u1, u2 = mk(), mk()
+    batcher.cat(u1)
+    assert batcher.empty() and batcher.ready() == 0
+    batcher.cat(u2)
+    assert batcher.ready() == 1
+    out = batcher.get(timeout=1)
+    np.testing.assert_allclose(
+        out["obs"], np.concatenate([u1["obs"], u2["obs"]], axis=1)
+    )
+    np.testing.assert_allclose(
+        out["core_state"][0],
+        np.concatenate([u1["core_state"][0], u2["core_state"][0]], axis=0),
+    )
+
+
+def test_cat_per_key_dims_overflow(rng):
+    """Overflow rows split correctly on every key's own axis."""
+    b = 3
+    mk = lambda: {
+        "x": rng.standard_normal((2, b, 2)).astype(np.float32),
+        "core_state": (rng.standard_normal((b, 5)).astype(np.float32),),
+    }
+    batcher = Batcher(batch_size=4, dim=1, dims={"core_state": 0})
+    items = [mk(), mk(), mk()]  # 9 rows -> two batches of 4, 1 carried
+    for it in items:
+        batcher.cat(it)
+    allx = np.concatenate([it["x"] for it in items], axis=1)
+    allc = np.concatenate([it["core_state"][0] for it in items], axis=0)
+    for i in range(2):
+        out = batcher.get(timeout=1)
+        np.testing.assert_allclose(out["x"], allx[:, 4 * i : 4 * (i + 1)])
+        np.testing.assert_allclose(
+            out["core_state"][0], allc[4 * i : 4 * (i + 1)]
+        )
+    assert batcher.empty()

@@ -1,0 +1,112 @@
+"""Integration tests for the examples layer.
+
+Reference strategy: test/integration/test_a2c.py trains the real A2C example
+and asserts learning-curve properties (return >100 for >=50% of the last
+logs, entropy bounds). Same bar here, on the CPU backend the whole suite
+runs under (conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+from moolib_tpu.examples.a2c import A2CConfig, train as a2c_train
+from moolib_tpu.examples.vtrace.experiment import (
+    VtraceConfig,
+    train as vtrace_train,
+)
+
+
+def _quiet(*a, **k):
+    pass
+
+
+@pytest.mark.integration
+def test_a2c_cartpole_learns():
+    cfg = A2CConfig(seed=0, total_steps=60_000, log_interval_steps=2_000)
+    logs = a2c_train(cfg, log_fn=_quiet)
+    assert len(logs) >= 20
+    tail = [r["mean_episode_return"] for r in logs[-10:]]
+    # Learning bar (reference: test/integration/test_a2c.py:16-36).
+    assert sum(r > 100 for r in tail) >= 5, f"tail returns {tail}"
+    entropies = [r["entropy"] for r in logs[-10:]]
+    assert all(0.05 < e < 0.69 for e in entropies), entropies
+    assert logs[-1]["updates"] > 100
+
+
+def test_vtrace_experiment_runs_and_checkpoints(tmp_path):
+    cfg = VtraceConfig(
+        env="cartpole",
+        total_steps=6_000,
+        actor_batch_size=8,
+        learn_batch_size=8,
+        virtual_batch_size=8,
+        num_actor_processes=2,
+        unroll_length=10,
+        log_interval_steps=2_000,
+        savedir=str(tmp_path),
+        checkpoint_interval=0.0,  # save at every opportunity
+        checkpoint_history_interval=None,
+        stats_interval=0.2,
+        seed=0,
+    )
+    logs = vtrace_train(cfg, log_fn=_quiet)
+    assert len(logs) == 3
+    assert logs[-1]["updates"] > 10
+    assert np.isfinite(logs[-1]["total_loss"])
+    # tsv + metadata + checkpoint written
+    assert (tmp_path / "logs.tsv").exists()
+    assert (tmp_path / "metadata.json").exists()
+    assert (tmp_path / "checkpoint.ckpt").exists()
+    # global stats eventually include our own env steps
+    assert logs[-1]["global_env_steps"] > 0
+
+    # Resume: checkpoint holder wins leader election and model_version
+    # carries over (reference: experiment.py:316-322).
+    vers = [r["model_version"] for r in logs]
+    cfg2 = VtraceConfig(**{**cfg.__dict__, "total_steps": 2_000})
+    logs2 = vtrace_train(cfg2, log_fn=_quiet)
+    assert logs2[0]["model_version"] >= vers[-1]
+
+
+def test_vtrace_synthetic_pixels_smoke(tmp_path):
+    """Pixel pipeline end-to-end on the synthetic Atari-shaped env with the
+    deep ResNet — a handful of updates, loss finite."""
+    cfg = VtraceConfig(
+        env="synthetic",
+        num_actions=4,
+        episode_length=40,
+        total_steps=640,
+        actor_batch_size=4,
+        learn_batch_size=4,
+        virtual_batch_size=4,
+        num_actor_processes=2,
+        num_actor_batches=2,
+        unroll_length=4,
+        log_interval_steps=320,
+        stats_interval=1e9,
+        seed=0,
+    )
+    logs = vtrace_train(cfg, log_fn=_quiet)
+    assert logs and logs[-1]["updates"] >= 1
+    assert np.isfinite(logs[-1]["total_loss"])
+
+
+def test_vtrace_lstm_smoke():
+    """LSTM core_state ([B, H]) must batch correctly alongside [T, B, ...]
+    unroll leaves (per-key Batcher dims)."""
+    cfg = VtraceConfig(
+        env="cartpole",
+        use_lstm=True,
+        total_steps=2_000,
+        actor_batch_size=4,
+        learn_batch_size=8,  # two unrolls per learn batch: exercises the cat
+        virtual_batch_size=8,
+        num_actor_processes=2,
+        unroll_length=5,
+        log_interval_steps=1_000,
+        stats_interval=1e9,
+        seed=0,
+    )
+    logs = vtrace_train(cfg, log_fn=_quiet)
+    assert logs and logs[-1]["updates"] >= 1
+    assert np.isfinite(logs[-1]["total_loss"])
