@@ -20,14 +20,18 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-import threading
-import time
 from typing import List, Optional
 
 import numpy as np
 
 import moolib_tpu
-from moolib_tpu.examples.common import EnvBatchState, StatMean, StatSum, Stats
+from moolib_tpu.examples.common import (
+    EnvBatchState,
+    InProcessBroker,
+    StatMean,
+    StatSum,
+    Stats,
+)
 from moolib_tpu.examples.envs import create_cartpole
 
 __all__ = ["A2CConfig", "train", "a2c_loss"]
@@ -116,32 +120,6 @@ def a2c_loss(params, apply_fn, batch, config):
     return total, metrics
 
 
-class _InProcessBroker:
-    """Broker on a background thread (reference: a2c example starts its own
-    Broker in-process, examples/a2c.py:268-275)."""
-
-    def __init__(self):
-        from moolib_tpu.rpc.broker import Broker
-
-        self.rpc = moolib_tpu.Rpc("broker")
-        self.rpc.listen("127.0.0.1:0")
-        self.address = self.rpc.debug_info()["listen"][0]
-        self._broker = Broker(self.rpc)
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
-
-    def _run(self):
-        while not self._stop.is_set():
-            self._broker.update()
-            time.sleep(0.05)
-
-    def close(self):
-        self._stop.set()
-        self._thread.join(timeout=5)
-        self.rpc.close()
-
-
 def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
     """Train A2C on CartPole; returns the list of logged stat rows."""
     import jax
@@ -160,7 +138,7 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
     broker = None
     broker_addr = cfg.broker
     if broker_addr is None:
-        broker = _InProcessBroker()
+        broker = InProcessBroker()
         broker_addr = broker.address
 
     rpc = moolib_tpu.Rpc(f"a2c-{moolib_tpu.create_uid()[:8]}")

@@ -15,6 +15,8 @@ unroll its bootstrap frame for free.
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -24,12 +26,42 @@ from moolib_tpu.utils import nest  # noqa: F401  (re-export)
 
 __all__ = [
     "EnvBatchState",
+    "InProcessBroker",
     "StatMean",
     "StatSum",
     "StatMax",
     "Stats",
     "nest",
 ]
+
+
+class InProcessBroker:
+    """Broker on a background thread, for single-process runs
+    (reference: the a2c example starts its own Broker in-process,
+    examples/a2c.py:268-275)."""
+
+    def __init__(self, update_interval: float = 0.05):
+        import moolib_tpu
+        from moolib_tpu.rpc.broker import Broker
+
+        self.rpc = moolib_tpu.Rpc("broker")
+        self.rpc.listen("127.0.0.1:0")
+        self.address = self.rpc.debug_info()["listen"][0]
+        self._broker = Broker(self.rpc)
+        self._interval = update_interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._broker.update()
+            time.sleep(self._interval)
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.rpc.close()
 
 
 class EnvBatchState:
@@ -67,6 +99,12 @@ class EnvBatchState:
             steps = np.asarray(env_out["episode_step"])[done]
             self._completed_returns.extend(float(r) for r in rets)
             self._completed_lengths.extend(float(s) for s in steps)
+            # Bound both buffers: callers that never drain one must not
+            # leak memory over millions of episodes.
+            if len(self._completed_returns) > 10_000:
+                del self._completed_returns[:-1_000]
+            if len(self._completed_lengths) > 10_000:
+                del self._completed_lengths[:-1_000]
         obs_keys = [
             k
             for k in env_out

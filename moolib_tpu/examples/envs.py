@@ -216,5 +216,23 @@ def create_atari(
         from gymnasium.wrappers import FrameStack
 
         env = FrameStack(env, frame_stack)
+
+    class _ChannelsLast(gymnasium.ObservationWrapper):
+        """Frame stacking stacks on a new LEADING axis; the models (flax
+        Conv) and the EnvPool layout are channels-last [84, 84, C]."""
+
+        def __init__(self, env):
+            super().__init__(env)
+            old = env.observation_space
+            self.observation_space = gymnasium.spaces.Box(
+                low=np.moveaxis(old.low, 0, -1),
+                high=np.moveaxis(old.high, 0, -1),
+                dtype=old.dtype,
+            )
+
+        def observation(self, obs):
+            return np.moveaxis(np.asarray(obs), 0, -1)
+
+    env = _ChannelsLast(env)
     env.reset(seed=index)
     return env

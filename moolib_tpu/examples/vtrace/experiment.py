@@ -66,6 +66,7 @@ class VtraceConfig:
     entropy_cost: float = 0.0006
     reward_clip: float = 1.0
     use_lstm: bool = False
+    model: str = "auto"  # auto | mlp | resnet | transformer
     total_steps: int = 500_000
     # infra
     broker: Optional[str] = None  # None -> in-process broker
@@ -94,17 +95,26 @@ def _make_env_fn(cfg: VtraceConfig):
 def _make_model(cfg: VtraceConfig):
     import jax.numpy as jnp
 
-    from moolib_tpu.models import A2CNet, ImpalaNet
+    from moolib_tpu.models import A2CNet, ImpalaNet, TransformerNet
 
-    if cfg.env == "cartpole":
-        return A2CNet(num_actions=2, use_lstm=cfg.use_lstm)
-    return ImpalaNet(
-        num_actions=cfg.num_actions,
-        use_lstm=cfg.use_lstm,
-        compute_dtype=jnp.bfloat16
-        if cfg.compute_dtype == "bfloat16"
-        else jnp.float32,
+    num_actions = 2 if cfg.env == "cartpole" else cfg.num_actions
+    dtype = (
+        jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     )
+    model = cfg.model
+    if model == "auto":
+        model = "mlp" if cfg.env == "cartpole" else "resnet"
+    if model == "mlp":
+        return A2CNet(num_actions=num_actions, use_lstm=cfg.use_lstm)
+    if model == "transformer":
+        return TransformerNet(num_actions=num_actions, compute_dtype=dtype)
+    if model == "resnet":
+        return ImpalaNet(
+            num_actions=num_actions,
+            use_lstm=cfg.use_lstm,
+            compute_dtype=dtype,
+        )
+    raise ValueError(f"unknown model {cfg.model!r}")
 
 
 def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
@@ -129,9 +139,9 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
     broker = None
     broker_addr = cfg.broker
     if broker_addr is None:
-        from moolib_tpu.examples.a2c import _InProcessBroker
+        from moolib_tpu.examples.common import InProcessBroker
 
-        broker = _InProcessBroker()
+        broker = InProcessBroker()
         broker_addr = broker.address
     rpc = moolib_tpu.Rpc(f"vtrace-{moolib_tpu.create_uid()[:8]}")
     rpc.listen("127.0.0.1:0")

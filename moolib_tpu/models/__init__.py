@@ -1,6 +1,7 @@
 from .a2c import A2CNet
 from .core import LSTMCore
 from .impala import ConvSequence, ImpalaNet, ResidualBlock
+from .transformer import TransformerNet
 
 __all__ = [
     "A2CNet",
@@ -8,4 +9,5 @@ __all__ = [
     "ConvSequence",
     "ImpalaNet",
     "ResidualBlock",
+    "TransformerNet",
 ]

@@ -1,0 +1,157 @@
+"""Transformer agent: long-context policy/value model.
+
+The reference's model zoo stops at MLP/LSTM/ResNet (reference:
+examples/atari/models.py, examples/a2c.py:47-83) — this adds the
+long-context family, built on the attention stack of
+:mod:`moolib_tpu.ops.attention` / :mod:`moolib_tpu.ops.ring_attention`.
+
+Same agent calling convention as every other model
+(:mod:`moolib_tpu.models.core`):
+
+    (logits_TBA, baseline_TB), state = net.apply(params, obs, done, state)
+
+Design:
+- The unroll IS the context: attention is causal over the T axis and
+  additionally **segment-masked** so no query attends across an episode
+  reset (segment ids = running count of ``done`` per batch lane). State
+  between unrolls is not carried (``core_state = ()``), mirroring how
+  context-window models consume RL unrolls; history length is set by
+  ``unroll_length``.
+- Pre-LN blocks, learned positional embedding over unroll positions, GELU
+  MLP; attention backend selectable: ``dense`` (short T), ``blockwise``
+  (O(T) memory), ``flash`` (pallas TPU kernel), ``ring`` (sequence-parallel
+  across the ``sp`` mesh axis — call inside shard_map with the T axis
+  sharded and pass globally-correct ``segment_ids``/``positions``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops import attention as attn_ops
+from ..ops import ring_attention as ring_ops
+
+__all__ = ["TransformerNet"]
+
+
+def segment_ids_from_done(done) -> jax.Array:
+    """[T, B] done flags -> [B, T] segment ids (done marks the FIRST frame
+    of a new episode, matching the EnvPool convention where a done frame
+    already holds the next episode's reset observation)."""
+    return jnp.cumsum(done.astype(jnp.int32), axis=0).T
+
+
+class _SelfAttention(nn.Module):
+    num_heads: int
+    backend: str
+    ring_axis: str
+
+    @nn.compact
+    def __call__(self, x, seg_bt, positions):
+        # x: [T, B, E] -> attention in [B, H, T, D].
+        T, B, E = x.shape
+        assert E % self.num_heads == 0, (E, self.num_heads)
+        D = E // self.num_heads
+        qkv = nn.Dense(3 * E, use_bias=False, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # [T, B, E] -> [B, H, T, D]
+            return t.reshape(T, B, self.num_heads, D).transpose(1, 2, 0, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if self.backend == "ring":
+            o = ring_ops.ring_attention(
+                q, k, v, axis_name=self.ring_axis, causal=True,
+                segment_ids=seg_bt, kv_segment_ids=seg_bt,
+            )
+        else:
+            o = attn_ops.attention(
+                q, k, v, backend=self.backend, causal=True,
+                segment_ids=seg_bt,
+            )
+        o = o.transpose(2, 0, 1, 3).reshape(T, B, E)
+        return nn.Dense(E, use_bias=False, name="out")(o)
+
+
+class _Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int
+    backend: str
+    ring_axis: str
+
+    @nn.compact
+    def __call__(self, x, seg_bt, positions):
+        h = nn.LayerNorm()(x)
+        x = x + _SelfAttention(
+            self.num_heads, self.backend, self.ring_axis, name="attn"
+        )(h, seg_bt, positions)
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.mlp_ratio * x.shape[-1])(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(x.shape[-1])(h)
+        return x
+
+
+class TransformerNet(nn.Module):
+    """Causal segment-masked transformer over the unroll axis."""
+
+    num_actions: int
+    d_model: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    max_len: int = 2048
+    attention_backend: str = "auto"  # dense|blockwise|flash|ring|auto
+    ring_axis: str = "sp"
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, done, core_state, segment_ids=None,
+                 positions=None):
+        # obs: [T, B, F] float vectors or [T, B, H, W, C] uint8 pixels.
+        T, B = obs.shape[:2]
+        x = obs.astype(self.compute_dtype)
+        if x.ndim == 5:  # pixels: small conv torso, stride-8 downsample
+            x = x.reshape(T * B, *obs.shape[2:]) / 255.0
+            x = nn.Conv(32, (8, 8), strides=(4, 4))(x)
+            x = nn.relu(x)
+            x = nn.Conv(self.d_model, (4, 4), strides=(2, 2))(x)
+            x = nn.relu(x)
+            x = x.mean(axis=(1, 2))  # global average pool
+            x = x.reshape(T, B, self.d_model)
+        else:
+            x = nn.Dense(self.d_model)(x)
+
+        if positions is None:
+            positions = jnp.arange(T)
+        pos_emb = nn.Embed(self.max_len, self.d_model, name="pos_emb")(
+            positions
+        )
+        x = x + pos_emb[:, None, :].astype(self.compute_dtype)
+
+        if segment_ids is None:
+            if self.attention_backend == "ring":
+                raise ValueError(
+                    "ring backend needs globally-correct segment_ids; "
+                    "compute them from the full done sequence before "
+                    "shard_map and pass the local shard in"
+                )
+            segment_ids = segment_ids_from_done(done)
+
+        for i in range(self.num_layers):
+            x = _Block(
+                self.num_heads, self.mlp_ratio, self.attention_backend,
+                self.ring_axis, name=f"block_{i}",
+            )(x, segment_ids, positions)
+
+        x = nn.LayerNorm()(x.astype(jnp.float32))
+        policy_logits = nn.Dense(self.num_actions, name="policy")(x)
+        baseline = nn.Dense(1, name="baseline")(x).squeeze(-1)
+        return (policy_logits, baseline), core_state
+
+    def initial_state(self, batch_size: int) -> Tuple:
+        return ()

@@ -1,4 +1,4 @@
-from . import vtrace
+from . import attention, ring_attention, vtrace
 from .batcher import Batcher
 
-__all__ = ["vtrace", "Batcher"]
+__all__ = ["vtrace", "attention", "ring_attention", "Batcher"]

@@ -1,0 +1,163 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+The reference has no long-context machinery (SURVEY.md §5) — this is new
+TPU-first scope, the multi-chip half of the long-context story. The design
+is the ring-attention construction (blockwise attention + ring-rotated
+key/value shards): every device holds one sequence shard [B, H, T_local, D];
+at each of the ``sp`` axis' N steps it folds the currently-held K/V shard
+into its online-softmax state (the combine math shared with
+:mod:`moolib_tpu.ops.attention`) and forwards the shard to its ring
+neighbor with ``lax.ppermute``. After N steps every query row has attended
+to the full global sequence, with O(T_local) memory per device and
+communication overlapping compute under XLA's async collectives.
+
+Differentiability comes for free: the loop is a ``lax.scan`` and
+``ppermute`` transposes to a ppermute, so ``jax.grad`` through ring
+attention is itself a ring collective — no custom VJP needed.
+
+``ring_attention`` must be called INSIDE ``shard_map`` (it uses
+``axis_index``); ``sequence_sharded_attention`` is the outside-jit
+convenience wrapper that builds the shard_map over a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import _finalize, _mask_bias, _online_block, _scale
+
+__all__ = ["ring_attention", "sequence_sharded_attention"]
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str = "sp",
+    causal: bool = False,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+):
+    """Exact global attention over per-device sequence shards.
+
+    Args (all per-device shards, global sequence = concat over ``axis_name``
+    in axis-index order):
+      q, k, v: [B, H, T_local, D]
+      segment_ids: [B, T_local] query segment ids (optional)
+      kv_segment_ids: [B, T_local] key segment ids (defaults to segment_ids)
+
+    Returns [B, H, T_local, D] — this device's rows of the global result.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    qf = _scale(q.astype(jnp.float32))
+
+    seg_q = segment_ids
+    seg_k0 = segment_ids if kv_segment_ids is None else kv_segment_ids
+    if seg_q is None and kv_segment_ids is not None:
+        raise ValueError(
+            "kv_segment_ids without segment_ids: key segments would be "
+            "silently ignored — pass both (or segment_ids alone)"
+        )
+    # Always carry a seg tensor so the scan structure is static; a constant
+    # zero tensor when segments are unused.
+    carry_seg = (
+        seg_k0 if seg_k0 is not None else jnp.zeros((B, T), jnp.int32)
+    )
+    use_seg = seg_q is not None
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    qpos = idx * T + jnp.arange(T)  # global positions of local q rows
+
+    def step(carry, i):
+        kb, vb, segb, m, l, acc = carry
+        # The shard we hold at step i originated on device (idx - i) mod n.
+        src = (idx - i) % n
+        bias = None
+        if causal:
+            kpos = src * T + jnp.arange(T)
+            bias = jnp.where(
+                qpos[:, None] >= kpos[None, :], 0.0, -1e30
+            )  # [T, T]
+        if use_seg:
+            same = seg_q[:, None, :, None] == segb[:, None, None, :]
+            seg_bias = jnp.where(same, 0.0, -1e30)
+            bias = seg_bias if bias is None else bias + seg_bias
+        m, l, acc = _online_block(
+            qf, kb.astype(jnp.float32), vb.astype(jnp.float32), bias, m, l, acc
+        )
+        # Rotate K/V (and key segments) one step around the ring.
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        segb = jax.lax.ppermute(segb, axis_name, perm)
+        return (kb, vb, segb, m, l, acc), None
+
+    # Fresh constants are 'unvarying' over the manual mesh axis; the scan
+    # body makes them device-varying, so the initial carry must be marked
+    # varying too (shard_map vma typing).
+    def pv(x):  # no-op if already varying (e.g. real segment-id shards)
+        vma = getattr(jax.typeof(x), "vma", frozenset())
+        if axis_name in vma:
+            return x
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pvary(x, (axis_name,))
+
+    m0 = pv(jnp.full((B, H, T), -jnp.inf, jnp.float32))
+    l0 = pv(jnp.zeros((B, H, T), jnp.float32))
+    a0 = pv(jnp.zeros((B, H, T, D), jnp.float32))
+    (kb, vb, segb, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, pv(carry_seg), m0, l0, a0), jnp.arange(n)
+    )
+    return _finalize(m, l, acc, v.dtype)
+
+
+def sequence_sharded_attention(
+    mesh: Mesh,
+    q,
+    k,
+    v,
+    axis_name: str = "sp",
+    causal: bool = False,
+    segment_ids: Optional[jax.Array] = None,
+):
+    """Ring attention over globally-shaped arrays: shards [B, H, T, D] along
+    T over ``axis_name`` of ``mesh``, runs :func:`ring_attention` inside
+    shard_map, returns the globally-shaped result."""
+    seq_spec = P(None, None, axis_name, None)
+    seg_spec = P(None, axis_name)
+
+    if segment_ids is None:
+
+        def f(q, k, v):
+            return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+        return jax.jit(
+            jax.shard_map(
+                f,
+                mesh=mesh,
+                in_specs=(seq_spec, seq_spec, seq_spec),
+                out_specs=seq_spec,
+            )
+        )(q, k, v)
+
+    def f(q, k, v, seg):
+        return ring_attention(
+            q, k, v, axis_name=axis_name, causal=causal,
+            segment_ids=seg, kv_segment_ids=seg,
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(seq_spec, seq_spec, seq_spec, seg_spec),
+            out_specs=seq_spec,
+        )
+    )(q, k, v, segment_ids)

@@ -1,0 +1,227 @@
+"""Attention stack tests: dense oracle vs blockwise vs pallas flash
+(interpret mode) vs ring attention on the 8-device virtual mesh, plus the
+TransformerNet agent model.
+
+The reference has no attention machinery (SURVEY.md §5) — the oracle here is
+dense softmax attention, property-tested the way the reference tests its
+Batcher against torch.stack/cat (test/unit/test_batcher.py:14-53).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from moolib_tpu.ops.attention import (
+    attention,
+    blockwise_attention,
+    dense_attention,
+    flash_attention,
+)
+from moolib_tpu.ops.ring_attention import (
+    ring_attention,
+    sequence_sharded_attention,
+)
+from moolib_tpu.parallel.mesh import make_mesh
+
+
+def _qkv(rng, B=2, H=3, T=64, D=16, dtype=np.float32):
+    return tuple(
+        jnp.asarray(rng.standard_normal((B, H, T, D)), dtype)
+        for _ in range(3)
+    )
+
+
+def _segs(rng, B=2, T=64):
+    return jnp.asarray(
+        np.cumsum(rng.random((B, T)) < 0.08, axis=1), jnp.int32
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_segs", [False, True])
+def test_blockwise_matches_dense(rng, causal, with_segs):
+    q, k, v = _qkv(rng)
+    seg = _segs(rng) if with_segs else None
+    o1 = dense_attention(q, k, v, causal=causal, segment_ids=seg)
+    o2 = blockwise_attention(
+        q, k, v, causal=causal, segment_ids=seg, block_k=16
+    )
+    np.testing.assert_allclose(o1, o2, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_segs", [False, True])
+def test_flash_matches_dense(rng, causal, with_segs):
+    q, k, v = _qkv(rng)
+    seg = _segs(rng) if with_segs else None
+    o1 = dense_attention(q, k, v, causal=causal, segment_ids=seg)
+    o3 = flash_attention(
+        q, k, v, causal=causal, segment_ids=seg, block_q=16, block_k=16
+    )
+    np.testing.assert_allclose(o1, o3, atol=2e-5)
+
+
+def test_blockwise_ragged_tail(rng):
+    """Tk not a multiple of block_k: padded keys must not attend."""
+    q, k, v = _qkv(rng, T=50)
+    o1 = dense_attention(q, k, v, causal=True)
+    o2 = blockwise_attention(q, k, v, causal=True, block_k=16)
+    np.testing.assert_allclose(o1, o2, atol=2e-5)
+
+
+def test_gradients_match(rng):
+    q, k, v = _qkv(rng, T=32)
+    seg = _segs(rng, T=32)
+
+    def loss(fn, inputs, **kw):
+        q, k, v = inputs
+        return jnp.sum(fn(q, k, v, causal=True, segment_ids=seg, **kw) ** 2)
+
+    g_dense = jax.grad(lambda i: loss(dense_attention, i))((q, k, v))
+    g_block = jax.grad(lambda i: loss(blockwise_attention, i, block_k=16))(
+        (q, k, v)
+    )
+    g_flash = jax.grad(
+        lambda i: loss(flash_attention, i, block_q=16, block_k=16)
+    )((q, k, v))
+    for a, b in zip(g_dense, g_block):
+        np.testing.assert_allclose(a, b, atol=1e-4)
+    for a, b in zip(g_dense, g_flash):
+        np.testing.assert_allclose(a, b, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_segs", [False, True])
+def test_ring_matches_dense(rng, causal, with_segs):
+    mesh = make_mesh(dp=1, sp=8)
+    q, k, v = _qkv(rng)
+    seg = _segs(rng) if with_segs else None
+    o1 = dense_attention(q, k, v, causal=causal, segment_ids=seg)
+    o2 = sequence_sharded_attention(
+        mesh, q, k, v, causal=causal, segment_ids=seg
+    )
+    np.testing.assert_allclose(o1, np.asarray(o2), atol=2e-5)
+
+
+def test_ring_gradients(rng):
+    mesh = make_mesh(dp=1, sp=8)
+    q, k, v = _qkv(rng, T=32, B=1, H=2, D=8)
+    spec = P(None, None, "sp", None)
+
+    def ring_loss(q):
+        f = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, causal=True),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+        )
+        return jnp.sum(f(q, k, v) ** 2)
+
+    g1 = jax.grad(
+        lambda q: jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+    )(q)
+    g2 = jax.jit(jax.grad(ring_loss))(q)
+    np.testing.assert_allclose(g1, np.asarray(g2), atol=1e-4)
+
+
+def test_attention_dispatcher(rng):
+    q, k, v = _qkv(rng, T=16)
+    o_auto = attention(q, k, v, causal=True)
+    o_dense = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(o_auto, o_dense, atol=2e-5)
+    with pytest.raises(ValueError):
+        attention(q, k, v, backend="nope")
+
+
+# -- TransformerNet agent ---------------------------------------------------
+
+
+def _net_and_params(rng_key, backend="dense", T=12, B=3, F=5, A=4):
+    from moolib_tpu.models import TransformerNet
+
+    net = TransformerNet(
+        num_actions=A, d_model=32, num_layers=2, num_heads=2,
+        attention_backend=backend,
+    )
+    obs = jnp.asarray(
+        np.random.default_rng(0).standard_normal((T, B, F)), jnp.float32
+    )
+    done = jnp.asarray(np.random.default_rng(1).random((T, B)) < 0.15)
+    params = net.init(rng_key, obs, done, ())
+    return net, params, obs, done
+
+
+def test_transformer_forward_shapes():
+    net, params, obs, done = _net_and_params(jax.random.PRNGKey(0))
+    (logits, baseline), state = net.apply(params, obs, done, ())
+    assert logits.shape == (12, 3, 4) and baseline.shape == (12, 3)
+    assert state == ()
+
+
+def test_transformer_backends_agree():
+    net_d, params, obs, done = _net_and_params(
+        jax.random.PRNGKey(0), backend="dense"
+    )
+    from moolib_tpu.models import TransformerNet
+
+    for backend in ("blockwise", "flash"):
+        net_b = TransformerNet(
+            num_actions=4, d_model=32, num_layers=2, num_heads=2,
+            attention_backend=backend,
+        )
+        (l1, b1), _ = net_d.apply(params, obs, done, ())
+        (l2, b2), _ = net_b.apply(params, obs, done, ())
+        np.testing.assert_allclose(l1, l2, atol=2e-4)
+        np.testing.assert_allclose(b1, b2, atol=2e-4)
+
+
+def test_transformer_respects_episode_boundaries():
+    """A query after a reset must not see pre-reset frames: changing frames
+    before the reset must not change post-reset outputs."""
+    net, params, obs, done = _net_and_params(jax.random.PRNGKey(0))
+    T, B = obs.shape[:2]
+    done = jnp.zeros((T, B), bool).at[6, 0].set(True)
+    (l1, _), _ = net.apply(params, obs, done, ())
+    obs2 = obs.at[:6, 0].add(10.0)  # pre-reset frames of lane 0
+    (l2, _), _ = net.apply(params, obs2, done, ())
+    np.testing.assert_allclose(l1[6:, 0], l2[6:, 0], atol=1e-5)
+    # sanity: pre-reset outputs DID change
+    assert float(jnp.max(jnp.abs(l1[:6, 0] - l2[:6, 0]))) > 1e-3
+
+
+def test_transformer_in_impala_learner():
+    """TransformerNet plugs into the IMPALA train step on a dp mesh."""
+    import optax
+
+    from moolib_tpu.learner import (
+        ImpalaConfig,
+        make_impala_train_step,
+        make_train_state,
+        replicate_state,
+    )
+    from moolib_tpu.parallel.mesh import shard_batch
+
+    net, params, obs, done = _net_and_params(
+        jax.random.PRNGKey(0), T=5, B=8
+    )
+    mesh = make_mesh(dp=8)
+    rng = np.random.default_rng(0)
+    T, B, A = 4, 8, 4
+    batch = {
+        "obs": jnp.asarray(
+            rng.standard_normal((T + 1, B, 5)), jnp.float32
+        ),
+        "done": jnp.asarray(rng.random((T + 1, B)) < 0.1),
+        "rewards": jnp.asarray(rng.standard_normal((T + 1, B)), jnp.float32),
+        "actions": jnp.asarray(rng.integers(0, A, (T, B)), jnp.int32),
+        "behavior_logits": jnp.zeros((T, B, A), jnp.float32),
+        "core_state": (),
+    }
+    opt = optax.adam(1e-3)
+    state = replicate_state(make_train_state(params, opt), mesh)
+    step = make_impala_train_step(
+        net.apply, opt, ImpalaConfig(), mesh=mesh, donate=False
+    )
+    state, metrics = step(state, shard_batch(mesh, batch))
+    assert np.isfinite(float(metrics["total_loss"]))
+    assert int(state.step) == 1

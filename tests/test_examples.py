@@ -110,3 +110,23 @@ def test_vtrace_lstm_smoke():
     logs = vtrace_train(cfg, log_fn=_quiet)
     assert logs and logs[-1]["updates"] >= 1
     assert np.isfinite(logs[-1]["total_loss"])
+
+
+def test_vtrace_transformer_smoke():
+    """Transformer agent (long-context family) through the full vtrace loop."""
+    cfg = VtraceConfig(
+        env="cartpole",
+        model="transformer",
+        total_steps=2_000,
+        actor_batch_size=4,
+        learn_batch_size=8,
+        virtual_batch_size=8,
+        num_actor_processes=2,
+        unroll_length=5,
+        log_interval_steps=1_000,
+        stats_interval=1e9,
+        seed=0,
+    )
+    logs = vtrace_train(cfg, log_fn=_quiet)
+    assert logs and logs[-1]["updates"] >= 1
+    assert np.isfinite(logs[-1]["total_loss"])
