@@ -33,8 +33,8 @@ in the worker: on done, the returned obs is the first obs of the next episode
 from __future__ import annotations
 
 import pickle
-import struct
 import threading
+import time
 from dataclasses import dataclass
 from multiprocessing import get_context
 from multiprocessing import shared_memory as mp_shm
@@ -51,9 +51,56 @@ __all__ = ["EnvPool", "EnvStepper", "EnvStepperFuture"]
 _ALIGN = 64  # align every array slab to cache lines, like the reference's
 # 64-byte aligned tensor allocations (src/transports/ipc.cc read path).
 
+_RING = 16  # command-ring slots per worker (>= num_batches suffices)
+_CMD_CLOSE = 0xFFFFFFFF
+
 
 def _align(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _get_native():
+    """Native semaphore ops for the shm data plane, or None (pipe fallback).
+
+    With the native module, step dispatch and completion ride process-shared
+    POSIX semaphores + SPSC command rings inside the segment — the
+    reference's design (src/shm.h:96-232 SharedSemaphore, src/env.cc:323-345
+    queue+semaphore dispatch) — instead of pickling pipe messages per step.
+    """
+    try:
+        from ..native import get_native
+
+        return get_native()
+    except Exception:
+        return None
+
+
+class _Ctrl:
+    """Control-block layout inside the shared segment (native mode)."""
+
+    def __init__(self, base: int, n_workers: int, num_batches: int):
+        from ..native import get_native
+
+        sem = get_native().sem_size()
+        self.cmd_sems = [base + w * sem for w in range(n_workers)]
+        done_base = base + n_workers * sem
+        self.done_sems = [done_base + b * sem for b in range(num_batches)]
+        ring_base = _align(done_base + num_batches * sem)
+        self.rings = [
+            ring_base + w * (_RING + 1) * 4 for w in range(n_workers)
+        ]
+        self.end = ring_base + n_workers * (_RING + 1) * 4
+
+    def ring_views(self, buf, w: int):
+        """(slots u32[_RING], tail u32[1]) views for worker w.
+
+        SPSC protocol: the producer keeps its head privately (the semaphore
+        count is the real hand-off), the consumer's tail lives in shm."""
+        slots = np.ndarray((_RING,), np.uint32, buffer=buf,
+                           offset=self.rings[w])
+        tail = np.ndarray((1,), np.uint32, buffer=buf,
+                          offset=self.rings[w] + _RING * 4)
+        return slots, tail
 
 
 @dataclass
@@ -117,7 +164,17 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
         msg = conn.recv()
         if msg[0] != "init":
             raise RuntimeError(f"expected init, got {msg[0]!r}")
-        _, shm_name, layout, num_batches = msg
+        _, shm_name, layout, num_batches, ctrl = msg
+        native = None
+        if ctrl is not None:
+            from ..native import get_native
+
+            native = get_native()
+            if native is None:
+                raise RuntimeError(
+                    "parent uses the native data plane but this worker "
+                    "could not load moolib_tpu.native"
+                )
         shm = mp_shm.SharedMemory(name=shm_name)
         try:
             buffers = [
@@ -133,15 +190,8 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
                     for k, v in obs.items():
                         buffers[b][k][first + i] = v
             conn.send(("ready", rank))
-            while True:
-                try:
-                    msg = conn.recv()
-                except EOFError:
-                    return  # parent died/closed: exit (keepalive semantics)
-                if msg[0] == "close":
-                    return
-                assert msg[0] == "step"
-                b = msg[1]
+
+            def step_slice(b: int):
                 buf = buffers[b]
                 actions = buf["action"]
                 for i, env in enumerate(envs):
@@ -161,7 +211,43 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
                     if done:
                         episode_step[i] = 0
                         episode_return[i] = 0.0
-                conn.send(("done", b))
+
+            if native is not None:
+                # Native loop (reference: EnvRunner::run, src/env.h:407-453):
+                # sem_wait for a command, pop the SPSC ring, step, post the
+                # buffer's done semaphore.
+                cmd_off = ctrl.cmd_sems[rank]
+                slots, tail_w = ctrl.ring_views(shm.buf, rank)
+                while True:
+                    # Periodic timeout so a vanished parent (no CLOSE ever
+                    # arriving) doesn't strand the worker forever: the still-
+                    # open pipe reports EOF when the parent dies, regardless
+                    # of who reaps orphans (subreaper-safe, unlike getppid).
+                    if not native.sem_wait(shm.buf, cmd_off, 1.0):
+                        try:
+                            if conn.poll(0):
+                                conn.recv()
+                        except (EOFError, OSError):
+                            return  # parent is gone
+                        continue
+                    tail = int(tail_w[0])
+                    b = int(slots[tail % _RING])
+                    tail_w[0] = tail + 1
+                    if b == _CMD_CLOSE:
+                        return
+                    step_slice(b)
+                    native.sem_post(shm.buf, ctrl.done_sems[b])
+            else:
+                while True:
+                    try:
+                        msg = conn.recv()
+                    except EOFError:
+                        return  # parent died/closed (keepalive semantics)
+                    if msg[0] == "close":
+                        return
+                    assert msg[0] == "step"
+                    step_slice(msg[1])
+                    conn.send(("done", msg[1]))
         finally:
             shm.close()
     except KeyboardInterrupt:
@@ -189,9 +275,12 @@ class EnvStepperFuture:
         self._event = event
 
     def result(self, timeout: Optional[float] = None):
-        if not self._event.wait(timeout):
+        pool = self._pool
+        if pool._ctrl is not None:
+            pool._wait_native(self._batch_index, timeout)
+        elif not self._event.wait(timeout):
             raise TimeoutError("EnvStepperFuture.result timed out")
-        return self._pool._collect(self._batch_index)
+        return pool._collect(self._batch_index)
 
 
 class EnvPool:
@@ -216,6 +305,12 @@ class EnvPool:
         if num_processes < 1 or batch_size < 1 or num_batches < 1:
             raise ValueError(
                 "num_processes, batch_size and num_batches must be >= 1"
+            )
+        if num_batches > _RING:
+            # The per-worker command ring must hold one command per
+            # in-flight buffer plus a CLOSE.
+            raise ValueError(
+                f"num_batches ({num_batches}) must be <= {_RING}"
             )
         if batch_size % num_processes != 0:
             raise ValueError(
@@ -289,16 +384,38 @@ class EnvPool:
                 slabs[k] = _Slab(offset, tuple(shape), dt.str)
                 offset = _align(offset + size)
             self._layout.append(slabs)
-        self._shm = mp_shm.SharedMemory(create=True, size=max(offset, 1))
+
+        # Native data plane: control block (semaphores + command rings)
+        # appended after the data slabs.
+        self._native = _get_native()
+        self._ctrl: Optional[_Ctrl] = None
+        total = offset
+        if self._native is not None:
+            self._ctrl = _Ctrl(_align(offset), num_processes, num_batches)
+            total = self._ctrl.end
+        self._shm = mp_shm.SharedMemory(create=True, size=max(total, 1))
         self._views = [
             {k: slab.view(self._shm.buf) for k, slab in slabs.items()}
             for slabs in self._layout
         ]
+        if self._ctrl is not None:
+            for off in self._ctrl.cmd_sems + self._ctrl.done_sems:
+                self._native.sem_init(self._shm.buf, off)
+            self._rings = []  # cached (slots, tail) views per worker
+            for w in range(num_processes):
+                slots, tail = self._ctrl.ring_views(self._shm.buf, w)
+                slots[:] = 0
+                tail[:] = 0
+                self._rings.append((slots, tail))
+            self._ring_heads = [0] * num_processes
 
         # Handshake 2: ship the layout; wait for all workers ready.
         try:
             for conn in self._conns:
-                conn.send(("init", self._shm.name, self._layout, num_batches))
+                conn.send(
+                    ("init", self._shm.name, self._layout, num_batches,
+                     self._ctrl)
+                )
             for conn in self._conns:
                 try:
                     kind, payload = conn.recv()
@@ -321,8 +438,13 @@ class EnvPool:
         self._events: list = [threading.Event() for _ in range(num_batches)]
         self._pending = [0] * num_batches
         self._waiter_error: Optional[str] = None
-        self._waiter = threading.Thread(target=self._drain_loop, daemon=True)
-        self._waiter.start()
+        self._waiter = None
+        if self._ctrl is None:
+            # Pipe mode: background thread collects per-worker completions.
+            self._waiter = threading.Thread(
+                target=self._drain_loop, daemon=True
+            )
+            self._waiter.start()
 
     # -- stepping ------------------------------------------------------------
 
@@ -354,9 +476,63 @@ class EnvPool:
             self._events[batch_index].clear()
             self._pending[batch_index] = self.num_processes
         np.copyto(slab, action)
-        for conn in self._conns:
-            conn.send(("step", batch_index))
+        if self._ctrl is not None:
+            # Native dispatch: ring push + semaphore post per worker
+            # (reference: src/env.cc:323-345).
+            for w in range(self.num_processes):
+                self._push_cmd(w, batch_index)
+        else:
+            for conn in self._conns:
+                conn.send(("step", batch_index))
         return EnvStepperFuture(self, batch_index, self._events[batch_index])
+
+    def _push_cmd(self, w: int, cmd: int):
+        slots, tail = self._rings[w]
+        head = self._ring_heads[w]
+        if head - int(tail[0]) >= _RING:
+            raise RuntimeError("command ring overflow (worker stuck?)")
+        slots[head % _RING] = cmd
+        self._ring_heads[w] = head + 1
+        self._native.sem_post(self._shm.buf, self._ctrl.cmd_sems[w])
+
+    def _wait_native(self, batch_index: int, timeout: Optional[float]):
+        """Wait for all workers' done posts on this buffer, with liveness
+        checks on each poll slice."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        off = self._ctrl.done_sems[batch_index]
+        remaining = self._pending[batch_index]
+        while remaining > 0:
+            slice_t = 0.5
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    self._pending[batch_index] = remaining
+                    raise TimeoutError("EnvStepperFuture.result timed out")
+                slice_t = min(slice_t, left)
+            if self._native.sem_wait(self._shm.buf, off, slice_t):
+                remaining -= 1
+                continue
+            self._check_workers_alive()
+            if self._closed:
+                raise RuntimeError(
+                    "EnvPool was closed with this step in flight"
+                )
+        self._pending[batch_index] = 0
+
+    def _check_workers_alive(self):
+        for w, p in enumerate(self._procs):
+            if not p.is_alive():
+                msg = f"env worker {w} died (exitcode {p.exitcode})"
+                # Pick up a worker's own error report if it sent one.
+                try:
+                    if self._conns[w].poll(0):
+                        kind, payload = self._conns[w].recv()
+                        if kind == "error":
+                            msg = f"env worker {w} failed: {payload}"
+                except (EOFError, OSError):
+                    pass
+                self._waiter_error = msg
+                raise RuntimeError(f"env worker died: {msg}")
 
     def _drain_loop(self):
         """Background thread collecting worker completions for all buffers."""
@@ -421,11 +597,18 @@ class EnvPool:
         # the closed pool and raise instead of hanging forever.
         for ev in self._events:
             ev.set()
-        for conn in self._conns:
-            try:
-                conn.send(("close",))
-            except (BrokenPipeError, OSError):
-                pass
+        if self._ctrl is not None:
+            for w in range(self.num_processes):
+                try:
+                    self._push_cmd(w, _CMD_CLOSE)
+                except RuntimeError:
+                    pass  # ring full: worker is stuck; terminate below
+        else:
+            for conn in self._conns:
+                try:
+                    conn.send(("close",))
+                except (BrokenPipeError, OSError):
+                    pass
         for p in self._procs:
             p.join(timeout=5)
         self._terminate()

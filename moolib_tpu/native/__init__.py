@@ -1,0 +1,109 @@
+"""Native runtime loader: builds and loads the C++ extension on demand.
+
+The reference ships its native layer as a pybind11 module compiled at
+install time (reference: CMakeLists.txt + src/moolib.cc). Here the extension
+is a single C++ translation unit compiled with the system toolchain on
+first use and cached next to the source; everything it accelerates has a
+pure-Python fallback, so the framework works (slower) without a compiler.
+
+Set ``MOOLIB_TPU_NO_NATIVE=1`` to force the pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from typing import Optional
+
+from ..utils import get_logger
+
+log = get_logger("native")
+
+__all__ = ["get_native", "build_native"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_native.cpp")
+
+_lock = threading.Lock()
+_cached = False
+_module = None
+
+
+def _so_path() -> str:
+    tag = sysconfig.get_config_var("SOABI") or "unknown"
+    return os.path.join(_DIR, f"_native.{tag}.so")
+
+
+def build_native(force: bool = False) -> Optional[str]:
+    """Compile the extension if needed; returns the .so path or None."""
+    out = _so_path()
+    if (
+        not force
+        and os.path.exists(out)
+        and os.path.getmtime(out) >= os.path.getmtime(_SRC)
+    ):
+        return out
+    cxx = os.environ.get("CXX", "g++")
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+        f"-I{include}", _SRC, "-o", out, "-pthread",
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.info("native build unavailable (%s); using pure-Python paths", e)
+        return None
+    if proc.returncode != 0:
+        log.info(
+            "native build failed; using pure-Python paths:\n%s",
+            proc.stderr[-2000:],
+        )
+        return None
+    return out
+
+
+def get_native():
+    """The loaded extension module, or None (pure-Python fallback)."""
+    global _cached, _module
+    if _cached:
+        return _module
+    with _lock:
+        if _cached:
+            return _module
+        if os.environ.get("MOOLIB_TPU_NO_NATIVE"):
+            _cached = True
+            return None
+        so = build_native()
+        if so is not None:
+            try:
+                spec = importlib.util.spec_from_file_location(
+                    "moolib_tpu.native._native", so
+                )
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+                sys.modules["moolib_tpu.native._native"] = mod
+                _module = mod
+            except Exception as e:  # corrupt cache, ABI mismatch, ...
+                log.info("native load failed (%s); rebuilding once", e)
+                so = build_native(force=True)
+                if so is not None:
+                    try:
+                        spec = importlib.util.spec_from_file_location(
+                            "moolib_tpu.native._native", so
+                        )
+                        mod = importlib.util.module_from_spec(spec)
+                        spec.loader.exec_module(mod)
+                        _module = mod
+                    except Exception:
+                        _module = None
+        _cached = True
+        if _module is not None:
+            log.info("native runtime loaded from %s", so)
+        return _module

@@ -405,12 +405,19 @@ def attention(q, k, v, backend: str = "auto", **kw):
     """Dispatcher: 'dense' | 'blockwise' | 'flash' | 'auto' (flash on TPU,
     dense for short sequences, blockwise otherwise)."""
     if backend == "auto":
-        if jax.default_backend() == "tpu":
+        Tq, Tk = q.shape[-2], k.shape[-2]
+        bq = min(kw.get("block_q", 256), Tq)
+        bk = min(kw.get("block_k", 256), Tk)
+        if jax.default_backend() == "tpu" and Tq % bq == 0 and Tk % bk == 0:
             backend = "flash"
-        elif q.shape[-2] * k.shape[-2] <= 1024 * 1024:
+        elif Tq * Tk <= 1024 * 1024:
             backend = "dense"
         else:
             backend = "blockwise"
+        if backend != "flash":
+            kw.pop("block_q", None)  # flash-only knob
+            if backend == "dense":
+                kw.pop("block_k", None)
     fn = {
         "dense": dense_attention,
         "blockwise": blockwise_attention,

@@ -197,21 +197,87 @@ def _decode(r: _Reader, tensors: List[np.ndarray]) -> Any:
             out[k] = _decode(r, tensors)
         return out
     if tag == _T_TENSOR:
-        idx, ndim = r.unpack(_IB)
-        shape = tuple(r.unpack(_Q)[0] for _ in range(ndim))
-        (dtlen,) = r.unpack(_B)
-        dt = np.dtype(bytes(r.take(dtlen)).decode())
-        return tensors[idx].view(dt).reshape(shape)
+        return _decode_tensor(r, tensors)
     if tag == _T_BIGINT:
         (n,) = r.unpack(_I)
         return int(bytes(r.take(n)).decode())
     if tag == _T_PICKLED:
-        (n,) = r.unpack(_Q)
-        return pickle.loads(r.take(n))
+        return _decode_pickled(r)
     raise ValueError(f"unknown wire tag {tag}")
 
 
+def _decode_tensor(r: _Reader, tensors: List[np.ndarray]) -> np.ndarray:
+    """Shared by the pure-Python decoder and the native decoder's fallback:
+    one place owns the tensor wire layout."""
+    idx, ndim = r.unpack(_IB)
+    shape = tuple(r.unpack(_Q)[0] for _ in range(ndim))
+    (dtlen,) = r.unpack(_B)
+    dt = np.dtype(bytes(r.take(dtlen)).decode())
+    return tensors[idx].view(dt).reshape(shape)
+
+
+def _decode_pickled(r: _Reader) -> Any:
+    (n,) = r.unpack(_Q)
+    return pickle.loads(r.take(n))
+
+
 _PAD = b"\x00" * _ALIGN
+
+
+def _get_native():
+    """The C++ serializer hot path (moolib_tpu/native/_native.cpp), or None.
+
+    Imported lazily so serial.py stays importable in stripped environments;
+    the native module implements the identical wire format and defers
+    tensor/pickle handling back to the pure-Python tag writers here.
+    """
+    global _native
+    if _native is _UNSET:
+        try:
+            from ..native import get_native
+
+            _native = get_native()
+        except Exception:
+            _native = None
+    return _native
+
+
+_UNSET = object()
+_native = _UNSET
+
+
+def _encode_toplevel(obj: Any) -> Tuple[bytes, List[np.ndarray]]:
+    native = _get_native()
+    tensors: List[np.ndarray] = []
+    if native is None:
+        meta = bytearray()
+        _encode(obj, meta, tensors)
+        return bytes(meta), tensors
+
+    def fallback(x) -> bytes:
+        chunk = bytearray()
+        _encode(x, chunk, tensors)  # tensor/pickle/np-scalar tags only
+        return bytes(chunk)
+
+    return native.encode(obj, fallback), tensors
+
+
+def _decode_toplevel(meta_view: memoryview, tensors: List[np.ndarray]) -> Any:
+    native = _get_native()
+    if native is None:
+        return _decode(_Reader(meta_view), tensors)
+
+    def fallback(tag: int, pos: int):
+        r = _Reader(meta_view)
+        r.pos = pos
+        if tag == _T_TENSOR:
+            return _decode_tensor(r, tensors), r.pos
+        if tag == _T_PICKLED:
+            return _decode_pickled(r), r.pos
+        raise ValueError(f"unexpected fallback tag {tag}")
+
+    obj, _end = native.decode(meta_view, fallback)
+    return obj
 
 
 def serialize(rid: int, fid: int, obj: Any) -> List[Any]:
@@ -222,9 +288,7 @@ def serialize(rid: int, fid: int, obj: Any) -> List[Any]:
     them alive until the write completes (same contract as the reference's
     SharedBufferHandle send path).
     """
-    meta = bytearray()
-    tensors: List[np.ndarray] = []
-    _encode(obj, meta, tensors)
+    meta, tensors = _encode_toplevel(obj)
 
     tensor_parts: List[Any] = []
     tensor_bytes = 0
@@ -248,7 +312,7 @@ def serialize(rid: int, fid: int, obj: Any) -> List[Any]:
 
     body_head = _BODY_HEAD.pack(rid, fid, len(tensors), len(meta))
     body_len = len(body_head) + len(meta) + tensor_bytes
-    out: List[Any] = [HEADER.pack(MAGIC, body_len) + body_head + bytes(meta)]
+    out: List[Any] = [HEADER.pack(MAGIC, body_len) + body_head + meta]
     out.extend(tensor_parts)
     return out
 
@@ -266,7 +330,7 @@ def deserialize_body(body: memoryview) -> Tuple[int, int, Any]:
     """
     r = _Reader(memoryview(body))
     rid, fid, n_tensors, meta_len = r.unpack(_BODY_HEAD)
-    meta = _Reader(r.take(meta_len))
+    meta_view = r.take(meta_len)
     # Tensor payload section begins after meta; parse it first so decode can
     # reference tensors by index.
     tensors: List[np.ndarray] = []
@@ -276,5 +340,5 @@ def deserialize_body(body: memoryview) -> Tuple[int, int, Any]:
         data = r.take(nb)
         r.take(-nb % _ALIGN)
         tensors.append(np.frombuffer(data, dtype=np.uint8))
-    obj = _decode(meta, tensors)
+    obj = _decode_toplevel(meta_view, tensors)
     return rid, fid, obj
