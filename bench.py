@@ -39,10 +39,12 @@ def main() -> None:
     devices = jax.devices()
     n_chips = len(devices)
 
-    # Benchmark config mirrors the reference's vtrace example defaults
-    # (reference: examples/vtrace/config.yaml — unroll_length 20,
-    # batch_size 32 virtual 128) at Atari frame shape 84x84x4.
-    T, B, H, W, C, A = 20, 32 * n_chips, 84, 84, 4, 6
+    # Unroll/frame shape mirrors the reference's vtrace example defaults
+    # (reference: examples/vtrace/config.yaml — unroll_length 20, Atari
+    # 84x84x4); B=128/chip is the virtual-batch scale (virtual_batch_size
+    # 128 in the same config) and saturates the MXU far better than the
+    # per-peer 32 (measured 4.2M vs 1.6M env-steps/s/chip on v5e).
+    T, B, H, W, C, A = 20, 128 * n_chips, 84, 84, 4, 6
     net = ImpalaNet(
         num_actions=A, use_lstm=False, compute_dtype=jnp.bfloat16
     )

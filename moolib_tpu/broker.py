@@ -27,7 +27,12 @@ def main(argv=None):
     rpc = Rpc("broker")
     rpc.listen(args.addr)
     broker = Broker(rpc)
-    print(f"moolib_tpu broker listening on {rpc.debug_info()['listen']}")
+    # Single clean address on stdout: launchers parse this line
+    # (moolib_tpu/examples/launch.py).
+    print(
+        f"moolib_tpu broker listening on {rpc.debug_info()['listen'][0]}",
+        flush=True,
+    )
     try:
         while True:
             broker.update()

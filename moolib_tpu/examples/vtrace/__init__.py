@@ -1,1 +1,14 @@
-from .experiment import VtraceConfig, train  # noqa: F401
+"""Elastic IMPALA/V-trace experiment package.
+
+Lazy re-exports: importing the package must not import the experiment
+module, so ``python -m moolib_tpu.examples.vtrace.experiment`` runs it
+exactly once (runpy executes the module fresh after importing the package).
+"""
+
+
+def __getattr__(name):
+    if name in ("VtraceConfig", "train"):
+        from . import experiment
+
+        return getattr(experiment, name)
+    raise AttributeError(name)

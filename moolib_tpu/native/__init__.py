@@ -49,9 +49,13 @@ def build_native(force: bool = False) -> Optional[str]:
         return out
     cxx = os.environ.get("CXX", "g++")
     include = sysconfig.get_paths()["include"]
+    # Compile to a process-unique temp path and os.replace() into place:
+    # concurrent first-use across processes (multi-peer launch, EnvPool
+    # workers) must never dlopen a half-written .so.
+    tmp = f"{out}.tmp.{os.getpid()}"
     cmd = [
         cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
-        f"-I{include}", _SRC, "-o", out, "-pthread",
+        f"-I{include}", _SRC, "-o", tmp, "-pthread",
     ]
     try:
         proc = subprocess.run(
@@ -65,7 +69,12 @@ def build_native(force: bool = False) -> Optional[str]:
             "native build failed; using pure-Python paths:\n%s",
             proc.stderr[-2000:],
         )
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
+    os.replace(tmp, out)
     return out
 
 

@@ -58,6 +58,17 @@ struct Writer {
   }
 };
 
+// u32 length/count fields must not silently truncate (the pure-Python
+// encoder's struct.pack('<I') raises on overflow — match it).
+bool check_u32(Py_ssize_t n) {
+  if (static_cast<uint64_t>(n) > UINT32_MAX) {
+    PyErr_Format(PyExc_OverflowError,
+                 "wire u32 field overflow: %zd", n);
+    return false;
+  }
+  return true;
+}
+
 // Encode obj into w; non-basic objects go through `fallback(obj)`, which
 // must return bytes (the already-encoded metadata chunk for that object —
 // it may also append to the shared tensor list it closed over).
@@ -103,6 +114,10 @@ int encode(PyObject* obj, Writer& w, PyObject* fallback) {
       Py_DECREF(s);
       return -1;
     }
+    if (!check_u32(n)) {
+      Py_DECREF(s);
+      return -1;
+    }
     w.u8(T_BIGINT);
     w.num<uint32_t>(static_cast<uint32_t>(n));
     w.raw(p, static_cast<size_t>(n));
@@ -118,6 +133,7 @@ int encode(PyObject* obj, Writer& w, PyObject* fallback) {
     Py_ssize_t n;
     const char* p = PyUnicode_AsUTF8AndSize(obj, &n);
     if (!p) return -1;
+    if (!check_u32(n)) return -1;
     w.u8(T_STR);
     w.num<uint32_t>(static_cast<uint32_t>(n));
     w.raw(p, static_cast<size_t>(n));
@@ -140,6 +156,7 @@ int encode(PyObject* obj, Writer& w, PyObject* fallback) {
   }
   if (PyList_CheckExact(obj)) {
     Py_ssize_t n = PyList_GET_SIZE(obj);
+    if (!check_u32(n)) return -1;
     w.u8(T_LIST);
     w.num<uint32_t>(static_cast<uint32_t>(n));
     for (Py_ssize_t i = 0; i < n; i++) {
@@ -149,6 +166,7 @@ int encode(PyObject* obj, Writer& w, PyObject* fallback) {
   }
   if (PyTuple_CheckExact(obj)) {
     Py_ssize_t n = PyTuple_GET_SIZE(obj);
+    if (!check_u32(n)) return -1;
     w.u8(T_TUPLE);
     w.num<uint32_t>(static_cast<uint32_t>(n));
     for (Py_ssize_t i = 0; i < n; i++) {
@@ -158,6 +176,7 @@ int encode(PyObject* obj, Writer& w, PyObject* fallback) {
     return 0;
   }
   if (PyDict_CheckExact(obj)) {
+    if (!check_u32(PyDict_GET_SIZE(obj))) return -1;
     w.u8(T_DICT);
     w.num<uint32_t>(static_cast<uint32_t>(PyDict_GET_SIZE(obj)));
     PyObject *key, *value;

@@ -1,0 +1,75 @@
+"""Launcher + plotter + tsv-record tests (reference: the launcher/plotter
+scripts of examples/, exercised at function level)."""
+
+import os
+import subprocess
+import sys
+
+from moolib_tpu.examples.common.record import TsvLogger, write_metadata
+from moolib_tpu.examples.launch import write_sbatch
+from moolib_tpu.examples.plot import read_tsv, render
+
+
+def test_tsv_logger_roundtrip(tmp_path):
+    path = str(tmp_path / "logs.tsv")
+    log = TsvLogger(path)
+    log.log({"a": 1.5, "b": "x"})
+    log.log({"a": 2.5, "b": "y", "late_key": 9})  # late keys dropped
+    log.log({"a": 3.5})  # missing keys -> empty
+    rows = read_tsv(path)
+    assert [r["a"] for r in rows] == [1.5, 2.5, 3.5]
+    assert rows[0]["b"] == "x" and rows[2]["b"] == ""
+    assert "late_key" not in rows[0]
+    # resume adopts the existing header
+    log2 = TsvLogger(path)
+    log2.log({"a": 4.5, "b": "z"})
+    assert read_tsv(path)[-1]["a"] == 4.5
+
+
+def test_write_metadata(tmp_path):
+    p = str(tmp_path / "metadata.json")
+    write_metadata(p, config={"x": 1})
+    import json
+
+    meta = json.load(open(p))
+    assert meta["config"] == {"x": 1} and "argv" in meta
+
+
+def test_render_plot():
+    pts = [(float(i), float(i * i)) for i in range(50)]
+    out = render(pts, width=40, height=10, x_label="t", y_label="v")
+    lines = out.splitlines()
+    assert len(lines) == 12
+    assert "v vs t" in lines[-1] and "50 points" in lines[-1]
+    # degenerate inputs don't crash
+    assert "no finite data" in render([])
+    assert render([(1.0, 2.0)])
+
+
+def test_write_sbatch(tmp_path):
+    path = write_sbatch(
+        str(tmp_path / "l.sbatch"), peers=4, broker="tcp://h:4431",
+        savedir="/shared/run", overrides=["env=synthetic"],
+    )
+    s = open(path).read()
+    assert "--array=0-3" in s
+    assert "broker=tcp://h:4431" in s
+    assert "peer$SLURM_ARRAY_TASK_ID" in s
+    assert os.access(path, os.X_OK)
+
+
+def test_broker_cli_prints_address():
+    """The launcher parses the broker's stdout line (reference strategy:
+    test/unit/test_broker.py exercises the CLI loop)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "moolib_tpu.broker", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "listening on" in line
+        addr = line.rsplit(" ", 1)[-1].strip()
+        assert addr.startswith("tcp://127.0.0.1:")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
