@@ -1,0 +1,150 @@
+"""Secondary benchmark: Accumulator/Group allreduce throughput.
+
+Mirrors the reference's manual allreduce benchmark binary
+(reference: test/test_multinode_allreduce.cc:16-110 — N processes sweep
+tensor sizes through the reduce tree and print timings), adapted to the two
+reduce planes of this framework:
+
+- **DCN plane**: the RPC tree allreduce (Broker + Group) with N in-process
+  peers over loopback — the elastic cross-host path the Accumulator uses.
+- **ICI plane**: ``lax.psum`` over the ``dp`` mesh axis inside jit — the
+  intra-cohort path (on CPU this exercises the virtual mesh; on a pod it
+  rides ICI).
+
+Prints one JSON line per (plane, size): {"plane", "peers", "mb", "gbps"}.
+The headline driver benchmark stays ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+
+def bench_rpc_tree(n_peers: int = 4, sizes=(2**16, 2**20, 2**23)):
+    import numpy as np
+
+    import moolib_tpu
+    from moolib_tpu.rpc.broker import Broker
+    from moolib_tpu.rpc.group import Group
+
+    moolib_tpu.set_log_level("error")
+    broker_rpc = moolib_tpu.Rpc("broker")
+    broker_rpc.listen("127.0.0.1:0")
+    addr = broker_rpc.debug_info()["listen"][0]
+    broker = Broker(broker_rpc)
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            broker.update()
+            time.sleep(0.02)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    peers = []
+    for i in range(n_peers):
+        rpc = moolib_tpu.Rpc(f"bench-{i}")
+        rpc.listen("127.0.0.1:0")
+        rpc.connect(addr)
+        peers.append((rpc, Group(rpc, group_name="bench", timeout=60.0)))
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        for _, g in peers:
+            g.update()
+        if all(len(g.members) == n_peers and g.active() for _, g in peers):
+            break
+        time.sleep(0.02)
+    else:
+        raise TimeoutError("bench group never stabilized")
+
+    try:
+        for size in sizes:
+            datas = [
+                np.full(size, float(i), np.float32) for i in range(n_peers)
+            ]
+            # warmup round
+            futs = [
+                g.all_reduce(f"warm.{size}", d)
+                for (_, g), d in zip(peers, datas)
+            ]
+            for f in futs:
+                f.result(timeout=60)
+            rounds = 5
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                futs = [
+                    g.all_reduce(f"r{r}.{size}", d)
+                    for (_, g), d in zip(peers, datas)
+                ]
+                for f in futs:
+                    f.result(timeout=60)
+            dt = (time.perf_counter() - t0) / rounds
+            expect = sum(range(n_peers))
+            assert abs(futs[0].result()[0] - expect) < 1e-5
+            # Algorithm bandwidth: each peer contributes + receives the full
+            # buffer once per round.
+            gbps = size * 4 * n_peers / dt / 1e9
+            print(json.dumps({
+                "plane": "dcn_rpc_tree", "peers": n_peers,
+                "mb": round(size * 4 / 1e6, 2),
+                "ms": round(dt * 1e3, 2), "gbps": round(gbps, 3),
+            }))
+    finally:
+        stop.set()
+        for rpc, g in peers:
+            g.close()
+            rpc.close()
+        broker_rpc.close()
+
+
+def bench_ici_psum(sizes=(2**20, 2**23, 2**25)):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from moolib_tpu.parallel.mesh import make_mesh
+
+    n = len(jax.devices())
+    if n < 2:
+        print(json.dumps({
+            "plane": "ici_psum", "peers": n,
+            "note": "single device: psum is a no-op, nothing to measure",
+        }))
+        return
+    mesh = make_mesh(dp=n)
+
+    for size in sizes:
+        x = jnp.asarray(np.ones((n, size), np.float32))
+
+        @jax.jit
+        def red(x):
+            def inner(x):
+                return jax.lax.psum(x, "dp")
+
+            return jax.shard_map(
+                inner, mesh=mesh, in_specs=P("dp", None),
+                out_specs=P("dp", None),
+            )(x)
+
+        out = red(x)
+        jax.block_until_ready(out)
+        rounds = 10
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            out = red(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / rounds
+        gbps = size * 4 * n / dt / 1e9
+        print(json.dumps({
+            "plane": "ici_psum", "peers": n,
+            "mb": round(size * 4 / 1e6, 2),
+            "ms": round(dt * 1e3, 2), "gbps": round(gbps, 3),
+        }))
+
+
+if __name__ == "__main__":
+    bench_rpc_tree()
+    bench_ici_psum()

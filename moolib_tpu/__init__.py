@@ -39,6 +39,7 @@ _EXPORTS = {
     "Accumulator": "moolib_tpu.parallel",
     # env execution & batching
     "EnvPool": "moolib_tpu.envpool",
+    "EnvRunner": "moolib_tpu.envpool",
     "EnvStepper": "moolib_tpu.envpool",
     "EnvStepperFuture": "moolib_tpu.envpool",
     "Batcher": "moolib_tpu.ops",

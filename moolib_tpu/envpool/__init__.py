@@ -1,3 +1,8 @@
 from .pool import EnvPool, EnvStepper, EnvStepperFuture
 
-__all__ = ["EnvPool", "EnvStepper", "EnvStepperFuture"]
+# Import-parity alias (reference exports EnvRunner, py/moolib/__init__.py:2-45).
+# In this design the worker loop lives inside the pool's spawned processes;
+# the pool object is the user-facing handle for both roles.
+EnvRunner = EnvPool
+
+__all__ = ["EnvPool", "EnvRunner", "EnvStepper", "EnvStepperFuture"]

@@ -1,0 +1,129 @@
+"""Multi-host bring-up: jax.distributed + global meshes + host-local batches.
+
+The reference scales across hosts purely through its RPC planes (broker +
+Accumulator over TCP). The TPU-native equivalent has two tiers, and this
+module owns the first:
+
+1. **One pod slice, many hosts** (this module): `jax.distributed.initialize`
+   makes every host a controller of the same XLA runtime; meshes built here
+   span ALL devices in the slice, collectives ride ICI, and each host feeds
+   its local shard of the global batch (its own EnvPool rollouts).
+2. **Many slices / elastic cohorts**: the Broker/Group/Accumulator planes
+   (:mod:`moolib_tpu.parallel.accumulator`) — unchanged, DCN-level.
+
+Typical multi-host experiment skeleton::
+
+    from moolib_tpu.parallel import distributed as dist
+    dist.initialize()                       # env-driven (TPU pods: automatic)
+    mesh = dist.global_mesh(dp=None)        # all devices in the slice
+    batch = dist.host_local_batch_to_global(mesh, local_batch)  # per-host shard
+    state, metrics = train_step(state, batch)  # same jitted step as 1 host
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import _resolve_batch_axes, batch_leaf_spec, make_mesh
+from ..utils import get_logger
+
+log = get_logger("distributed")
+
+__all__ = [
+    "initialize",
+    "is_initialized",
+    "global_mesh",
+    "host_local_batch_to_global",
+    "process_count",
+    "process_index",
+]
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize the multi-controller runtime (idempotent).
+
+    On TPU pods all arguments are discovered from the environment; off-pod
+    (e.g. CPU fleets) pass them explicitly. Call BEFORE any jax computation.
+    """
+    global _initialized
+    if _initialized:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "jax.distributed up: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def global_mesh(
+    dp: Optional[int] = None, tp: int = 1, sp: int = 1
+) -> Mesh:
+    """Mesh over ALL devices of the slice (every host must call this with
+    the same arguments; device order is jax.devices(), identical on all
+    controllers)."""
+    return make_mesh(dp=dp, tp=tp, sp=sp, devices=jax.devices())
+
+
+def host_local_batch_to_global(
+    mesh: Mesh,
+    batch,
+    batch_axis: int = 1,
+    batch_axes: Optional[dict] = None,
+):
+    """Assemble a dp-sharded GLOBAL batch from each host's LOCAL arrays.
+
+    Every host passes its own rollouts (local batch size = global /
+    process_count); the result is a global jax.Array whose shards live where
+    they were produced — no cross-host batch shuffling, the analogue of the
+    reference's per-peer EnvPool feeding the shared model
+    (reference: examples/vtrace/experiment.py per-peer acting).
+    """
+    axes = _resolve_batch_axes(batch_axes, batch_axis)
+
+    def leaf(x, a):
+        x = np.asarray(x)
+        spec = batch_leaf_spec(x, a)
+        sharding = NamedSharding(mesh, spec)
+        global_shape = list(x.shape)
+        if np.ndim(x) > a:
+            global_shape[a] = x.shape[a] * jax.process_count()
+        return jax.make_array_from_process_local_data(
+            sharding, x, tuple(global_shape)
+        )
+
+    if isinstance(batch, dict):
+        return {
+            k: jax.tree_util.tree_map(
+                lambda x, a=axes.get(k, batch_axis): leaf(x, a), v
+            )
+            for k, v in batch.items()
+        }
+    return jax.tree_util.tree_map(lambda x: leaf(x, batch_axis), batch)

@@ -68,6 +68,9 @@ FID_ACK = 8
 FID_USER_BASE = 1000  # reference: reqCallOffset(1000)
 
 _DEFAULT_TIMEOUT = 30.0
+# Stream buffer limit: large tensor bodies arrive via readexactly; a bigger
+# high-water mark means fewer transport pauses on multi-MB gradient bundles.
+_STREAM_LIMIT = 4 * 1024 * 1024
 
 
 def fid_for(name: str) -> int:
@@ -435,14 +438,16 @@ class Rpc:
         scheme, target = _split_addr(addr)
         if scheme == "unix":
             server = await asyncio.start_unix_server(
-                lambda r, w: self._on_accept("unix", r, w), path=_unix_path(target)
+                lambda r, w: self._on_accept("unix", r, w),
+                path=_unix_path(target), limit=_STREAM_LIMIT,
             )
             self._servers.append(server)
             self._listen_addrs.append(f"unix:{target}")
             return
         host, port = _host_port(target)
         server = await asyncio.start_server(
-            lambda r, w: self._on_accept("tcp", r, w), host=host, port=port
+            lambda r, w: self._on_accept("tcp", r, w), host=host, port=port,
+            limit=_STREAM_LIMIT,
         )
         self._servers.append(server)
         if port == 0:
@@ -455,7 +460,7 @@ class Rpc:
             try:
                 userver = await asyncio.start_unix_server(
                     lambda r, w: self._on_accept("unix", r, w),
-                    path=_unix_path(upath),
+                    path=_unix_path(upath), limit=_STREAM_LIMIT,
                 )
                 self._servers.append(userver)
                 self._listen_addrs.append(f"unix:{upath}")
@@ -506,14 +511,16 @@ class Rpc:
                 if "unix" not in self._transports:
                     return None
                 reader, writer = await asyncio.open_unix_connection(
-                    path=_unix_path(target)
+                    path=_unix_path(target), limit=_STREAM_LIMIT
                 )
                 conn = _Conn("unix", reader, writer)
             else:
                 if "tcp" not in self._transports:
                     return None
                 host, port = _host_port(target)
-                reader, writer = await asyncio.open_connection(host, port)
+                reader, writer = await asyncio.open_connection(
+                    host, port, limit=_STREAM_LIMIT
+                )
                 conn = _Conn("tcp", reader, writer)
         except OSError as e:
             log.debug("connect %s failed: %s", addr, e)

@@ -1,0 +1,110 @@
+"""Elastic fault tolerance, end to end: kill a peer mid-training and the
+survivor resyncs and keeps updating.
+
+This is the reference's flagship capability (reference: broker expels silent
+peers src/broker.h:205-235, group change cancels collectives
+src/group.h:453-460, Accumulator re-elects and resumes
+src/accumulator.cc:555-626; the reference exercises churn in-process in
+test/test_reduce.py — here real OS processes die with SIGKILL).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from moolib_tpu.examples.plot import read_tsv
+
+
+def _peer(broker_addr, savedir, extra=()):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # conftest set cpu in-process only
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [
+        sys.executable, "-m", "moolib_tpu.examples.vtrace.experiment",
+        f"broker={broker_addr}",
+        f"savedir={savedir}",
+        "env=cartpole",
+        "total_steps=100000000",  # effectively forever; the test kills them
+        "actor_batch_size=8",
+        "learn_batch_size=8",
+        "virtual_batch_size=8",  # one peer can fill the virtual batch alone
+        "num_actor_processes=2",
+        "unroll_length=5",
+        "log_interval_steps=500",
+        "stats_interval=0.5",
+    ] + list(extra)
+    return subprocess.Popen(
+        cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT
+    )
+
+
+def _rows(savedir):
+    path = os.path.join(savedir, "logs.tsv")
+    if not os.path.exists(path):
+        return []
+    try:
+        return read_tsv(path)
+    except Exception:
+        return []
+
+
+def _wait_progress(savedir, min_updates, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        rows = _rows(savedir)
+        if rows and rows[-1].get("updates", 0) >= min_updates:
+            return rows[-1]
+        time.sleep(0.5)
+    raise TimeoutError(
+        f"{what}: no progress past {min_updates} updates in {timeout}s; "
+        f"last rows: {_rows(savedir)[-2:]}"
+    )
+
+
+@pytest.mark.integration
+def test_peer_death_resync(tmp_path):
+    broker = subprocess.Popen(
+        [sys.executable, "-m", "moolib_tpu.broker", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    procs = []
+    try:
+        addr = None
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            line = broker.stdout.readline()
+            if "listening on" in line:
+                addr = line.rsplit(" ", 1)[-1].strip()
+                break
+        assert addr, "broker never reported its address"
+
+        d0, d1 = str(tmp_path / "p0"), str(tmp_path / "p1")
+        p0 = _peer(addr, d0)
+        p1 = _peer(addr, d1)
+        procs = [p0, p1]
+
+        # Both peers make progress together.
+        _wait_progress(d0, 10, 120, "peer0 initial")
+        _wait_progress(d1, 10, 120, "peer1 initial")
+
+        # SIGKILL peer1: no goodbye, no cleanup — the hard failure mode.
+        p1.send_signal(signal.SIGKILL)
+        p1.wait(timeout=10)
+
+        # Peer0 must keep updating well past where it was (resync + solo
+        # virtual batches). Allow generous time for expiry + re-election.
+        before = _rows(d0)[-1]["updates"]
+        _wait_progress(d0, before + 30, 120, "peer0 after peer1 death")
+
+        assert p0.poll() is None, "survivor crashed after peer death"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait(timeout=10)
+        broker.terminate()
+        broker.wait(timeout=10)
