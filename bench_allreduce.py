@@ -22,12 +22,65 @@ import threading
 import time
 
 
-def bench_rpc_tree(n_peers: int = 4, sizes=(2**16, 2**20, 2**23)):
+def _tree_worker(rank: int, n_peers: int, addr: str, sizes, out_q):
+    """One OS process per peer — the honest DCN shape (the reference's
+    multinode bench runs one process per node the same way)."""
     import numpy as np
 
     import moolib_tpu
-    from moolib_tpu.rpc.broker import Broker
     from moolib_tpu.rpc.group import Group
+
+    moolib_tpu.set_log_level("error")
+    rpc = moolib_tpu.Rpc(f"bench-{rank}")
+    rpc.listen("127.0.0.1:0")
+    rpc.connect(addr)
+    group = Group(rpc, group_name="bench", timeout=120.0)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        group.update()
+        if len(group.members) == n_peers and group.active():
+            break
+        time.sleep(0.02)
+    else:
+        out_q.put(("error", rank, "group never stabilized"))
+        return
+
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            group.update()
+            time.sleep(0.05)
+
+    threading.Thread(target=pump, daemon=True).start()
+    try:
+        for size in sizes:
+            data = np.full(size, float(rank), np.float32)
+            group.all_reduce(f"warm.{size}", data).result(timeout=120)
+            rounds = 5
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                result = group.all_reduce(
+                    f"r{r}.{size}", data
+                ).result(timeout=120)
+            dt = (time.perf_counter() - t0) / rounds
+            expect = sum(range(n_peers))
+            assert abs(float(result[0]) - expect) < 1e-5
+            if rank == 0:
+                out_q.put(("result", size, dt))
+    except Exception as e:
+        out_q.put(("error", rank, f"{type(e).__name__}: {e}"))
+    finally:
+        stop.set()
+        group.close()
+        rpc.close()
+
+
+def bench_rpc_tree(n_peers: int = 4, sizes=(2**16, 2**20, 2**23)):
+    import multiprocessing as mp
+
+    import moolib_tpu
+    from moolib_tpu.rpc.broker import Broker
 
     moolib_tpu.set_log_level("error")
     broker_rpc = moolib_tpu.Rpc("broker")
@@ -43,59 +96,37 @@ def bench_rpc_tree(n_peers: int = 4, sizes=(2**16, 2**20, 2**23)):
 
     threading.Thread(target=pump, daemon=True).start()
 
-    peers = []
-    for i in range(n_peers):
-        rpc = moolib_tpu.Rpc(f"bench-{i}")
-        rpc.listen("127.0.0.1:0")
-        rpc.connect(addr)
-        peers.append((rpc, Group(rpc, group_name="bench", timeout=60.0)))
-    deadline = time.monotonic() + 15
-    while time.monotonic() < deadline:
-        for _, g in peers:
-            g.update()
-        if all(len(g.members) == n_peers and g.active() for _, g in peers):
-            break
-        time.sleep(0.02)
-    else:
-        raise TimeoutError("bench group never stabilized")
-
+    ctx = mp.get_context("spawn")
+    out_q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_tree_worker, args=(i, n_peers, addr, sizes, out_q),
+            daemon=True,
+        )
+        for i in range(n_peers)
+    ]
+    for p in procs:
+        p.start()
     try:
         for size in sizes:
-            datas = [
-                np.full(size, float(i), np.float32) for i in range(n_peers)
-            ]
-            # warmup round
-            futs = [
-                g.all_reduce(f"warm.{size}", d)
-                for (_, g), d in zip(peers, datas)
-            ]
-            for f in futs:
-                f.result(timeout=60)
-            rounds = 5
-            t0 = time.perf_counter()
-            for r in range(rounds):
-                futs = [
-                    g.all_reduce(f"r{r}.{size}", d)
-                    for (_, g), d in zip(peers, datas)
-                ]
-                for f in futs:
-                    f.result(timeout=60)
-            dt = (time.perf_counter() - t0) / rounds
-            expect = sum(range(n_peers))
-            assert abs(futs[0].result()[0] - expect) < 1e-5
+            kind, a, b = out_q.get(timeout=300)
+            if kind == "error":
+                raise RuntimeError(f"worker {a}: {b}")
+            dt = b
             # Algorithm bandwidth: each peer contributes + receives the full
             # buffer once per round.
-            gbps = size * 4 * n_peers / dt / 1e9
+            gbps = a * 4 * n_peers / dt / 1e9
             print(json.dumps({
                 "plane": "dcn_rpc_tree", "peers": n_peers,
-                "mb": round(size * 4 / 1e6, 2),
+                "mb": round(a * 4 / 1e6, 2),
                 "ms": round(dt * 1e3, 2), "gbps": round(gbps, 3),
-            }))
+            }), flush=True)
     finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
         stop.set()
-        for rpc, g in peers:
-            g.close()
-            rpc.close()
         broker_rpc.close()
 
 

@@ -54,6 +54,18 @@ REDUCE_OPS: Dict[str, Callable] = {
     "max": _max,
 }
 
+# Elementwise builtin ops can be reduced chunk-by-chunk; payloads above the
+# threshold are split into ~_CHUNK_BYTES pieces that flow through the tree as
+# independent concurrent sub-ops. This pipelines the hops (chunk i reduces
+# while chunk i+1 is in transit) and spreads the numpy reduction over the
+# executor threads, where the unchunked path serializes full-buffer
+# transfer -> add -> transfer per tree level.
+_ELEMENTWISE = frozenset({_sum, _prod, _min, _max})
+_CHUNK_BYTES = int(__import__("os").environ.get(
+    "MOOLIB_TPU_ALLREDUCE_CHUNK", 1 << 20
+))
+_CHUNK_THRESHOLD = 2 * _CHUNK_BYTES if _CHUNK_BYTES else (1 << 62)
+
 
 class AllReduce(Future):
     """Future for one collective op (reference surface: moolib.AllReduce)."""
@@ -200,7 +212,13 @@ class Group:
             # (reference: src/group.h:453-460).
             cancelled = list(self._active.values())
             self._active.clear()
-            self._parked.clear()
+            # Drop parks of the epoch we are leaving (provably stale). Parks
+            # under any OTHER id stay: a faster peer may already be reducing
+            # in an epoch whose push hasn't reached us (they age out via
+            # _expire_ops if that epoch never arrives).
+            if old is not None:
+                for key in [k for k in self._parked if _is_current(k, old)]:
+                    del self._parked[key]
         for op in cancelled:
             op.future._set_exception(
                 RpcError(f"allreduce {op.key} cancelled: membership changed")
@@ -233,8 +251,22 @@ class Group:
     def all_reduce(self, name: str, data: Any,
                    op: Union[str, Callable] = "sum") -> AllReduce:
         """Start an async tree allreduce; returns a Future
-        (reference: AllReduceService::allReduce, src/group.h:687-787)."""
+        (reference: AllReduceService::allReduce, src/group.h:687-787).
+
+        Multi-MB payloads under elementwise builtin ops are chunked into
+        concurrent sub-ops for pipelined transfer (see _CHUNK_BYTES)."""
         op_fn = _resolve_op(op)
+        if op_fn in _ELEMENTWISE:
+            leaves = nest.flatten(data)
+            if (
+                all(isinstance(x, np.ndarray) for x in leaves)
+                and sum(x.nbytes for x in leaves) > _CHUNK_THRESHOLD
+            ):
+                return self._all_reduce_chunked(name, data, leaves, op_fn)
+        return self._all_reduce_one(name, data, op_fn)
+
+    def _all_reduce_one(self, name: str, data: Any,
+                        op_fn: Callable) -> AllReduce:
         with self._lock:
             if self._sync_id is None or not self._members:
                 raise RpcError(
@@ -257,13 +289,91 @@ class Group:
         self._maybe_forward(op_obj)
         return fut
 
+    def _all_reduce_chunked(self, name: str, data: Any, leaves: List[np.ndarray],
+                            op_fn: Callable) -> AllReduce:
+        """Split an elementwise reduce into concurrent ~_CHUNK_BYTES sub-ops.
+
+        Chunk boundaries depend only on the leaf shapes (identical on every
+        member), so all peers produce matching sub-op keys. Each sub-op's
+        payload is a flat list of array views; the parent future reassembles
+        the original pytree when the last sub-op lands."""
+        pieces: List[tuple] = []  # (leaf_idx, flat view)
+        for li, leaf in enumerate(leaves):
+            if not leaf.flags.c_contiguous:
+                leaf = np.ascontiguousarray(leaf)
+            flat = leaf.reshape(-1)
+            per = max(1, _CHUNK_BYTES // max(1, flat.itemsize))
+            if flat.nbytes <= _CHUNK_BYTES:
+                pieces.append((li, flat))
+            else:
+                for s in range(0, flat.size, per):
+                    pieces.append((li, flat[s:s + per]))
+        groups: List[List[tuple]] = []
+        cur: List[tuple] = []
+        cur_bytes = 0
+        for p in pieces:
+            if cur and cur_bytes + p[1].nbytes > _CHUNK_BYTES:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes += p[1].nbytes
+        if cur:
+            groups.append(cur)
+
+        parent = AllReduce(f"{self._sync_id}.{self.group_name}::{name}")
+        results: List[Any] = [None] * len(groups)
+        remaining = [len(groups)]
+        done_lock = threading.Lock()
+
+        def reassemble():
+            per_leaf: Dict[int, List[np.ndarray]] = {}
+            for group, res in zip(groups, results):
+                for (li, _view), arr in zip(group, res):
+                    per_leaf.setdefault(li, []).append(np.asarray(arr))
+            out_leaves = []
+            for li, leaf in enumerate(leaves):
+                parts = per_leaf[li]
+                flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+                out_leaves.append(flat.reshape(leaf.shape))
+            return nest.unflatten_as(data, out_leaves)
+
+        def make_cb(gi):
+            def cb(fut):
+                try:
+                    res = fut.result(timeout=0)
+                except Exception as e:
+                    parent._set_exception(e)
+                    return
+                with done_lock:
+                    results[gi] = res
+                    remaining[0] -= 1
+                    last = remaining[0] == 0
+                if last:
+                    try:
+                        parent._set_result(reassemble())
+                    except Exception as e:  # defensive: shape mismatch
+                        parent._set_exception(e)
+            return cb
+
+        subs = []
+        for gi, group in enumerate(groups):
+            payload = [arr for (_li, arr) in group]
+            subs.append(self._all_reduce_one(f"{name}#c{gi}", payload, op_fn))
+        for gi, f in enumerate(subs):
+            f.add_done_callback(make_cb(gi))
+        return parent
+
     def _reduce_in(self, op_key: str, payload):
         """A child's partial arrived (reference: reduce, src/group.h:570-629)."""
         with self._lock:
             op = self._active.get(op_key)
             if op is None:
-                if not _is_current(op_key, self._sync_id):
-                    return  # stale epoch: drop
+                # Park arrivals for ops we haven't started — including ones
+                # under a sync id we haven't APPLIED yet: epoch pushes race
+                # the first reduces of the new epoch, so a "foreign" id may
+                # be the future, not the past (epoch ids are opaque). Truly
+                # stale parks age out via _expire_ops; parks for epochs we
+                # skip entirely are pruned on resync.
                 self._parked.setdefault(op_key, []).append(
                     (op_key, payload, time.monotonic())
                 )

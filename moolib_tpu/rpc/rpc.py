@@ -65,12 +65,14 @@ FID_KEEPALIVE = 5
 FID_LOOKING_FOR_PEER = 6
 FID_PEER_FOUND = 7
 FID_ACK = 8
+FID_NACK = 9
+FID_POKE = 10
 FID_USER_BASE = 1000  # reference: reqCallOffset(1000)
 
 _DEFAULT_TIMEOUT = 30.0
-# Stream buffer limit: large tensor bodies arrive via readexactly; a bigger
-# high-water mark means fewer transport pauses on multi-MB gradient bundles.
-_STREAM_LIMIT = 4 * 1024 * 1024
+# Write-buffer high-water mark: multi-MB gradient bundles should stream out
+# without pausing the writer on every transport buffer fill.
+_WRITE_HIGH_WATER = 8 * 1024 * 1024
 
 
 def fid_for(name: str) -> int:
@@ -294,15 +296,16 @@ class _Conn:
     """One live connection (reference: RpcConnectionImpl over a transport)."""
 
     __slots__ = (
-        "transport", "reader", "writer", "task", "peer_name", "peer_id",
+        "transport", "sock", "proto", "peer_name", "peer_id", "outbound",
         "latency", "last_recv", "last_send", "created", "explicit_addr",
     )
 
-    def __init__(self, transport: str, reader, writer):
+    def __init__(self, transport: str, sock, proto: "_FrameProtocol",
+                 outbound: bool):
         self.transport = transport
-        self.reader = reader
-        self.writer = writer
-        self.task: Optional[asyncio.Task] = None
+        self.sock = sock          # asyncio Transport
+        self.proto = proto
+        self.outbound = outbound  # we dialed it (vs accepted)
         self.peer_name: Optional[str] = None
         self.peer_id: Optional[str] = None
         self.latency = Ewma(alpha=0.25)
@@ -310,6 +313,110 @@ class _Conn:
         self.last_send = time.monotonic()
         self.created = time.monotonic()
         self.explicit_addr: Optional[str] = None
+
+    def is_closing(self) -> bool:
+        return self.sock is None or self.sock.is_closing()
+
+    def close(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except Exception:
+                pass
+
+
+class _FrameProtocol(asyncio.BufferedProtocol):
+    """Zero-copy frame receiver.
+
+    asyncio's StreamReader tops out well below loopback line rate on
+    multi-MB bodies (extra buffer copies + 256KB recv chunks); this
+    BufferedProtocol hands the kernel a view directly into the frame being
+    assembled (``recv_into`` semantics), reaching raw-socket throughput —
+    the asyncio-native equivalent of the reference's iovec socket reads
+    (reference: src/transports/socket.cc scatter/gather path).
+    """
+
+    def __init__(self, rpc: "Rpc", transport_name: str,
+                 outbound: bool = False):
+        self._rpc = rpc
+        self._transport_name = transport_name
+        self._outbound = outbound
+        self.conn: Optional[_Conn] = None
+        self._head = bytearray(serial.HEADER.size)
+        self._head_got = 0
+        self._body: Optional[bytearray] = None
+        self._body_got = 0
+        self._can_write = asyncio.Event()
+        self._can_write.set()
+
+    # -- connection lifecycle -------------------------------------------------
+
+    def connection_made(self, transport):
+        transport.set_write_buffer_limits(high=_WRITE_HIGH_WATER)
+        self.conn = _Conn(
+            self._transport_name, transport, self, self._outbound
+        )
+        self._rpc._register_conn(self.conn)
+
+    def connection_lost(self, exc):
+        self._can_write.set()
+        if self.conn is not None:
+            self._rpc._drop_conn(self.conn, f"connection lost: {exc}")
+
+    def eof_received(self):
+        return False  # close on EOF
+
+    # -- write flow control ---------------------------------------------------
+
+    def pause_writing(self):
+        self._can_write.clear()
+
+    def resume_writing(self):
+        self._can_write.set()
+
+    # -- zero-copy read path --------------------------------------------------
+
+    def get_buffer(self, sizehint: int) -> memoryview:
+        if self._body is None:
+            return memoryview(self._head)[self._head_got:]
+        return memoryview(self._body)[self._body_got:]
+
+    def buffer_updated(self, nbytes: int):
+        conn = self.conn
+        if conn is None:
+            return
+        conn.last_recv = time.monotonic()
+        while nbytes:
+            if self._body is None:
+                self._head_got += nbytes
+                nbytes = 0
+                if self._head_got == len(self._head):
+                    magic, body_len = serial.HEADER.unpack(self._head)
+                    self._head_got = 0
+                    if magic != serial.MAGIC:
+                        self._rpc._drop_conn(
+                            conn, "bad magic (corrupt stream)"
+                        )
+                        return
+                    self._body = bytearray(body_len)
+                    self._body_got = 0
+            else:
+                self._body_got += nbytes
+                nbytes = 0
+                if self._body_got == len(self._body):
+                    body, self._body = self._body, None
+                    try:
+                        rid, fid, obj = serial.deserialize_body(
+                            memoryview(body)
+                        )
+                        self._rpc._dispatch(conn, rid, fid, obj)
+                    except Exception as e:
+                        log.error(
+                            "frame dispatch error on %s: %s",
+                            conn.peer_name, e,
+                        )
+                        self._rpc._drop_conn(conn, f"protocol error: {e}")
+                        return
 
 
 class _Peer:
@@ -326,7 +433,7 @@ class _Peer:
 
 class _Outgoing:
     __slots__ = ("rid", "peer_name", "fname", "frames", "future", "deadline",
-                 "sent_at", "conn")
+                 "sent_at", "conn", "poked_at", "acked")
 
     def __init__(self, rid, peer_name, fname, frames, future, deadline):
         self.rid = rid
@@ -337,6 +444,22 @@ class _Outgoing:
         self.deadline = deadline
         self.sent_at = time.monotonic()
         self.conn: Optional[_Conn] = None
+        self.poked_at = 0.0
+        self.acked = False
+
+
+def _boot_id() -> str:
+    """Host boot identity for reachability gating: unix-socket addresses are
+    only dialable by peers sharing this id (reference tags ipc addresses the
+    same way, src/transports/ipc.cc:280-315)."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip()
+    except OSError:
+        return pysocket.gethostname()
+
+
+_BOOT_ID = _boot_id()
 
 
 _live_rpcs: "weakref.WeakSet[Rpc]" = weakref.WeakSet()
@@ -357,6 +480,16 @@ class Rpc:
         self._name = name or f"rpc-{secrets.token_hex(8)}"
         self._peer_id = secrets.token_hex(16)
         self._timeout = _DEFAULT_TIMEOUT
+        # Liveness probing: keepalive after this much send-silence; a
+        # connection silent (nothing received) for 4 intervals is torn down
+        # and its in-flight requests re-routed (reference: 4 failed probes
+        # close the connection, src/rpc.cc:1625-1665).
+        self._keepalive_interval = 2.0
+        # Request-level reliability: poke the server about an unanswered
+        # request after max(4x EWMA latency, this floor); a NACK (server
+        # never saw it) triggers an immediate resend over the current best
+        # transport (reference: processTimeout, src/rpc.cc:1414-1498).
+        self._poke_min = 0.5
         self._transports = {"tcp", "unix"}
         self._functions: Dict[int, Tuple[str, Callable]] = {}
         self._queues: Dict[str, Queue] = {}
@@ -418,6 +551,11 @@ class Rpc:
     def set_timeout(self, seconds: float):
         self._timeout = float(seconds)
 
+    def set_keepalive_interval(self, seconds: float):
+        """Silence probe cadence; a connection that stays silent for 4
+        intervals is closed and its in-flight calls re-routed."""
+        self._keepalive_interval = float(seconds)
+
     def set_transports(self, transports):
         ts = set(transports)
         unknown = ts - {"tcp", "unix", "ipc"}
@@ -437,17 +575,17 @@ class Rpc:
     async def _listen(self, addr: str):
         scheme, target = _split_addr(addr)
         if scheme == "unix":
-            server = await asyncio.start_unix_server(
-                lambda r, w: self._on_accept("unix", r, w),
-                path=_unix_path(target), limit=_STREAM_LIMIT,
+            server = await self._loop.create_unix_server(
+                lambda: self._accept_proto("unix"), path=_unix_path(target)
             )
             self._servers.append(server)
-            self._listen_addrs.append(f"unix:{target}")
+            # Advertise with the host boot-id so remote hosts skip the dial
+            # (reference: ipc reachability keys, src/transports/ipc.cc:280-315).
+            self._listen_addrs.append(f"unix:{_BOOT_ID}:{target}")
             return
         host, port = _host_port(target)
-        server = await asyncio.start_server(
-            lambda r, w: self._on_accept("tcp", r, w), host=host, port=port,
-            limit=_STREAM_LIMIT,
+        server = await self._loop.create_server(
+            lambda: self._accept_proto("tcp"), host=host, port=port
         )
         self._servers.append(server)
         if port == 0:
@@ -458,14 +596,16 @@ class Rpc:
         if "unix" in self._transports:
             upath = f"moolib-tpu-{self._peer_id[:16]}"
             try:
-                userver = await asyncio.start_unix_server(
-                    lambda r, w: self._on_accept("unix", r, w),
-                    path=_unix_path(upath), limit=_STREAM_LIMIT,
+                userver = await self._loop.create_unix_server(
+                    lambda: self._accept_proto("unix"), path=_unix_path(upath)
                 )
                 self._servers.append(userver)
-                self._listen_addrs.append(f"unix:{upath}")
+                self._listen_addrs.append(f"unix:{_BOOT_ID}:{upath}")
             except OSError:
                 pass
+
+    def _accept_proto(self, transport_name: str) -> "_FrameProtocol":
+        return _FrameProtocol(self, transport_name)
 
     def connect(self, addr: str):
         """Connect to a peer address. Explicit connections auto-reconnect
@@ -492,7 +632,7 @@ class Rpc:
         entry = self._explicit.get(addr)
         if entry is None or self._closed or entry["dialing"]:
             return
-        if entry["conn"] is not None and not entry["conn"].writer.is_closing():
+        if entry["conn"] is not None and not entry["conn"].is_closing():
             return
         entry["dialing"] = True
         entry["last_try"] = time.monotonic()
@@ -510,30 +650,33 @@ class Rpc:
             if scheme == "unix":
                 if "unix" not in self._transports:
                     return None
-                reader, writer = await asyncio.open_unix_connection(
-                    path=_unix_path(target), limit=_STREAM_LIMIT
+                if ":" in target:
+                    boot, _, path = target.partition(":")
+                    if boot != _BOOT_ID:
+                        return None  # different host: its unix socket is
+                        # unreachable, don't waste a dial
+                    target = path
+                _t, proto = await self._loop.create_unix_connection(
+                    lambda: _FrameProtocol(self, "unix", outbound=True),
+                    path=_unix_path(target),
                 )
-                conn = _Conn("unix", reader, writer)
             else:
                 if "tcp" not in self._transports:
                     return None
                 host, port = _host_port(target)
-                reader, writer = await asyncio.open_connection(
-                    host, port, limit=_STREAM_LIMIT
+                _t, proto = await self._loop.create_connection(
+                    lambda: _FrameProtocol(self, "tcp", outbound=True),
+                    host, port,
                 )
-                conn = _Conn("tcp", reader, writer)
         except OSError as e:
             log.debug("connect %s failed: %s", addr, e)
             return None
-        self._anon_conns.append(conn)
-        conn.task = self._loop.create_task(self._read_loop(conn))
-        await self._send_greeting(conn)
-        return conn
+        return proto.conn  # registered (and greeted) by connection_made
 
-    def _on_accept(self, transport: str, reader, writer):
-        conn = _Conn(transport, reader, writer)
+    def _register_conn(self, conn: _Conn):
+        """Called by the protocol for both accepted and dialed connections;
+        the greeting exchange later binds the conn to a named peer."""
         self._anon_conns.append(conn)
-        conn.task = self._loop.create_task(self._read_loop(conn))
         self._loop.create_task(self._send_greeting(conn))
 
     async def _send_greeting(self, conn: _Conn):
@@ -548,38 +691,24 @@ class Rpc:
 
     async def _write(self, conn: _Conn, frames: List[Any]):
         try:
-            conn.writer.writelines(frames)
+            if conn.is_closing():
+                raise ConnectionError("connection is closing")
+            conn.sock.writelines(frames)
             conn.last_send = time.monotonic()
-            await conn.writer.drain()
+            # Flow control: wait while the transport's write buffer is above
+            # its high-water mark (the drain() equivalent).
+            if not conn.proto._can_write.is_set():
+                await conn.proto._can_write.wait()
         except (ConnectionError, OSError) as e:
             self._drop_conn(conn, f"write failed: {e}")
             raise
 
-    async def _read_loop(self, conn: _Conn):
-        reader = conn.reader
-        try:
-            while True:
-                head = await reader.readexactly(serial.HEADER.size)
-                magic, body_len = serial.HEADER.unpack(head)
-                if magic != serial.MAGIC:
-                    raise RpcError("bad magic (corrupt stream)")
-                body = await reader.readexactly(body_len)
-                conn.last_recv = time.monotonic()
-                rid, fid, obj = serial.deserialize_body(memoryview(body))
-                self._dispatch(conn, rid, fid, obj)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
-            self._drop_conn(conn, f"read loop ended: {e}")
-        except asyncio.CancelledError:
-            pass
-        except Exception as e:
-            log.error("read loop error on %s: %s", conn.peer_name, e)
-            self._drop_conn(conn, f"protocol error: {e}")
-
     def _drop_conn(self, conn: _Conn, why: str):
-        try:
-            conn.writer.close()
-        except Exception:
-            pass
+        log.debug("%s: drop_conn %s %s peer=%s closing=%s (%s)",
+                  self._name, conn.transport,
+                  "out" if conn.outbound else "in",
+                  conn.peer_name, conn.is_closing(), why)
+        conn.close()
         if conn in self._anon_conns:
             self._anon_conns.remove(conn)
         if conn.explicit_addr is not None:
@@ -614,6 +743,18 @@ class Rpc:
             self._on_looking_for_peer(conn, rid, obj)
         elif fid == FID_PEER_FOUND:
             self._on_peer_found(obj)
+        elif fid == FID_POKE:
+            self._on_poke(conn, rid)
+        elif fid == FID_ACK:
+            out = self._outgoing.get(rid)
+            if out is not None:
+                out.acked = True
+        elif fid == FID_NACK:
+            # Server never saw the request (lost in a connection teardown):
+            # resend immediately over the current best route.
+            out = self._outgoing.get(rid)
+            if out is not None and not out.future.done():
+                self._loop.create_task(self._send_out(out))
         elif fid in (FID_SUCCESS, FID_ERROR, FID_FNF):
             self._on_response(conn, rid, fid, obj)
         elif fid >= FID_USER_BASE:
@@ -627,6 +768,27 @@ class Rpc:
             # Self-connection: drop (reference: onGreeting rejects self).
             self._drop_conn(conn, "self connection")
             return
+        existing = self._peers.get(name)
+        if (existing is not None and existing.peer_id is not None
+                and existing.peer_id != obj["peer_id"]):
+            live = any(
+                not c.is_closing() for c in existing.conns.values()
+            )
+            if live:
+                # Two distinct live peers claiming one name would corrupt
+                # routing (reference: onGreeting rejects the collision,
+                # src/rpc.cc:2184-2330). Last-writer must NOT win.
+                log.error(
+                    "%s: rejecting greeting: name %r already claimed by a "
+                    "live peer with a different id", self._name, name,
+                )
+                self._drop_conn(conn, "peer name collision")
+                return
+            # Restarted incarnation reusing the name: stale addresses and
+            # dead conns belong to the old identity — start clean.
+            existing.addresses.clear()
+            for old_conn in list(existing.conns.values()):
+                self._drop_conn(old_conn, "stale incarnation")
         conn.peer_name = name
         conn.peer_id = obj["peer_id"]
         if conn in self._anon_conns:
@@ -636,9 +798,27 @@ class Rpc:
         for a in obj.get("addresses", []):
             if a not in peer.addresses:
                 peer.addresses.append(a)
+        log.debug(
+            "%s: greeting from %s on %s %s conn", self._name, name,
+            "outbound" if conn.outbound else "inbound", conn.transport,
+        )
         old = peer.conns.get(conn.transport)
         if old is not None and old is not conn:
-            self._drop_conn(old, "replaced by newer connection")
+            if (not old.is_closing() and old.outbound != conn.outbound):
+                # Simultaneous cross-dial: both sides dialed at once. Each
+                # side must keep the SAME socket or each ends up holding the
+                # conn the other just closed (deadlocking the pair). Rule
+                # both sides agree on: keep the conn dialed by the peer with
+                # the smaller peer_id.
+                keep_outbound = self._peer_id < obj["peer_id"]
+                if conn.outbound != keep_outbound:
+                    self._drop_conn(conn, "cross-dial loser")
+                    return
+                self._drop_conn(old, "cross-dial loser")
+            else:
+                # Same direction (a reconnect): the dialer knows best —
+                # newest wins. Or old is already closing.
+                self._drop_conn(old, "replaced by newer connection")
         peer.conns[conn.transport] = conn
         if peer.found_event is not None:
             peer.found_event.set()
@@ -703,6 +883,9 @@ class Rpc:
             return  # duplicate (resend after reconnect): suppress re-execution
         self._mark_recent(key)
         entry = self._functions.get(fid)
+        if log.isEnabledFor(10):
+            log.debug("%s: request rid=%d %s from %s", self._name, rid,
+                      entry[0] if entry else f"fid {fid}", peer_name)
         if entry is None:
             self._loop.create_task(
                 self._write(
@@ -723,7 +906,7 @@ class Rpc:
                 target = None
                 if peer and peer.conns:
                     target = _best_conn(peer)
-                elif not conn.writer.is_closing():
+                elif not conn.is_closing():
                     target = conn
                 if target is not None:
                     self._loop.create_task(self._write(target, frames))
@@ -740,6 +923,23 @@ class Rpc:
         self._response_cache[key] = frames
         while len(self._response_cache) > 4096:
             self._response_cache.popitem(last=False)
+
+    def _on_poke(self, conn: _Conn, rid: int):
+        """Server side of the poke protocol: the client asks whether we ever
+        received request ``rid``. Known + answered -> replay the cached
+        response; known + executing -> ACK (keep waiting); unknown -> NACK
+        (client resends)."""
+        key = (conn.peer_id or conn.peer_name or "?", rid)
+        if key in self._recent_rids:
+            cached = self._response_cache.get(key)
+            frames = cached if cached is not None else serial.serialize(
+                rid, FID_ACK, None
+            )
+            self._loop.create_task(self._write(conn, frames))
+        else:
+            self._loop.create_task(
+                self._write(conn, serial.serialize(rid, FID_NACK, None))
+            )
 
     def _on_response(self, conn: _Conn, rid: int, fid: int, obj):
         out = self._outgoing.pop(rid, None)
@@ -760,7 +960,7 @@ class Rpc:
 
     def define(self, name: str, fn: Optional[Callable] = None, *,
                batch_size: Optional[int] = None, device: Optional[Any] = None,
-               pad: bool = False):
+               pad: bool = False, inline: bool = False):
         """Register ``fn`` as callable by peers under ``name``.
 
         Tensor arguments arrive as **read-only** numpy views aliasing the
@@ -774,10 +974,17 @@ class Rpc:
         reply sliced back) — keeps shapes static so a jitted TPU handler
         compiles once instead of once per observed batch size.
         Usable as a decorator when ``fn`` is omitted.
+
+        ``inline=True`` runs the handler directly on the IO thread instead
+        of the executor — for short, non-blocking handlers this removes two
+        thread hops per call, which dominates at high message rates (the
+        reference similarly dispatches trivial service callbacks without a
+        scheduler hop). Inline handlers must never block.
         """
         if fn is None:
             return lambda f: (self.define(name, f, batch_size=batch_size,
-                                          device=device, pad=pad), f)[1]
+                                          device=device, pad=pad,
+                                          inline=inline), f)[1]
         if batch_size is not None:
             queue = self.define_queue(
                 name, batch_size=batch_size, dynamic_batching=True
@@ -799,7 +1006,10 @@ class Rpc:
                     respond(fn(*args, **kwargs), None)
                 except Exception as e:
                     respond(None, f"{type(e).__name__}: {e}")
-            self._executor.submit(run)
+            if inline:
+                run()
+            else:
+                self._executor.submit(run)
 
         self._functions[fid_for(name)] = (name, handler)
         return fn
@@ -851,6 +1061,7 @@ class Rpc:
     def async_(self, peer: str, func: str, *args, **kwargs) -> Future:
         fut = Future()
         rid = (next(self._rid_counter) << 1) | 1
+        log.debug("%s: call %s::%s rid=%d", self._name, peer, func, rid)
         frames = serial.serialize(rid, fid_for(func), (args, kwargs))
         out = _Outgoing(rid, peer, func, frames, fut,
                         time.monotonic() + self._timeout)
@@ -929,6 +1140,7 @@ class Rpc:
         while not self._closed:
             try:
                 now = time.monotonic()
+                ka = self._keepalive_interval
                 for rid, out in list(self._outgoing.items()):
                     if out.future.done():
                         self._outgoing.pop(rid, None)
@@ -942,22 +1154,62 @@ class Rpc:
                         )
                     elif out.conn is None:
                         await self._send_out(out)
+                    elif not out.acked:
+                        # Unanswered and un-acked: poke the server after a
+                        # latency-scaled silence so a request lost in a
+                        # connection handover is resent well before the
+                        # deadline (reference: src/rpc.cc:1414-1498).
+                        lat = out.conn.latency.value or 0.0
+                        poke_after = min(
+                            max(4.0 * lat, self._poke_min), self._timeout / 2
+                        )
+                        if now - max(out.sent_at, out.poked_at) > poke_after:
+                            out.poked_at = now
+                            peer = self._peers.get(out.peer_name)
+                            conn = _best_conn(peer) if peer and peer.conns \
+                                else None
+                            if conn is None:
+                                out.conn = None  # re-route next tick
+                            else:
+                                try:
+                                    await self._write(
+                                        conn,
+                                        serial.serialize(
+                                            out.rid, FID_POKE, None
+                                        ),
+                                    )
+                                except Exception:
+                                    pass
                 # re-dial dropped/failed explicit connections
                 for addr, entry in list(self._explicit.items()):
                     conn = entry["conn"]
-                    dead = conn is None or conn.writer.is_closing()
+                    dead = conn is None or conn.is_closing()
                     if dead and not entry["dialing"] and now - entry["last_try"] > 1.0:
                         self._loop.create_task(self._dial_explicit(addr))
-                # keepalives after 10s silence (reference: rpc.cc:1625-1665)
-                for peer in self._peers.values():
+                # Keepalive silent conns; tear down half-open ones. Both
+                # sides keepalive when idle, so a healthy peer is never
+                # recv-silent for 4 intervals — hitting that means the peer
+                # host froze or died without RST and in-flight calls must be
+                # re-routed now, not at expiry (reference: rpc.cc:1625-1665).
+                for peer in list(self._peers.values()):
                     for conn in list(peer.conns.values()):
-                        if now - conn.last_send > 10.0:
+                        if now - conn.last_recv > 4.0 * ka:
+                            self._drop_conn(
+                                conn,
+                                f"silent for {now - conn.last_recv:.1f}s "
+                                f"(> 4 keepalive intervals)",
+                            )
+                        elif now - conn.last_send > ka:
                             try:
                                 await self._write(
                                     conn, serial.serialize(0, FID_KEEPALIVE, None)
                                 )
                             except Exception:
                                 pass
+                # Anonymous conns that never complete a greeting are GC'd.
+                for conn in list(self._anon_conns):
+                    if now - conn.last_recv > max(4.0 * ka, 10.0):
+                        self._drop_conn(conn, "no greeting")
             except Exception as e:
                 log.error("timeout loop error: %s", e)
             await asyncio.sleep(0.1)
@@ -997,15 +1249,9 @@ class Rpc:
         def shutdown():
             for peer in self._peers.values():
                 for conn in peer.conns.values():
-                    try:
-                        conn.writer.close()
-                    except Exception:
-                        pass
+                    conn.close()
             for conn in self._anon_conns:
-                try:
-                    conn.writer.close()
-                except Exception:
-                    pass
+                conn.close()
             for server in self._servers:
                 server.close()
             self._loop.stop()

@@ -1,0 +1,243 @@
+"""Failure-detection and request-reliability tests.
+
+Covers the reference's reliability layer beyond TCP (reference:
+processTimeout resend/poke, src/rpc.cc:1414-1498; keepalive-driven
+connection teardown after 4 silent probes, src/rpc.cc:1625-1665; greeting
+name-collision rejection, src/rpc.cc:2184-2330; ipc reachability keys,
+src/transports/ipc.cc:280-315).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from moolib_tpu.rpc import Rpc, RpcError
+from moolib_tpu.rpc.rpc import _BOOT_ID, FID_USER_BASE
+
+
+class StallableProxy:
+    """TCP forwarder that can silently stop forwarding (half-open link:
+    sockets stay open, bytes go nowhere — like a frozen peer host)."""
+
+    def __init__(self, target_host, target_port):
+        self.target = (target_host, target_port)
+        self.stalled = False
+        self._threads = []
+        self._socks = []
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(8)
+        self.port = self._lsock.getsockname()[1]
+        self._closed = False
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                cli, _ = self._lsock.accept()
+            except OSError:
+                return
+            try:
+                srv = socket.create_connection(self.target, timeout=5)
+            except OSError:
+                cli.close()
+                continue
+            self._socks += [cli, srv]
+            for a, b in ((cli, srv), (srv, cli)):
+                t = threading.Thread(
+                    target=self._pump, args=(a, b), daemon=True
+                )
+                t.start()
+                self._threads.append(t)
+
+    def _pump(self, src, dst):
+        while not self._closed:
+            try:
+                data = src.recv(65536)
+            except OSError:
+                return
+            if not data:
+                return
+            if self.stalled:
+                continue  # swallow silently; connection stays open
+            try:
+                dst.sendall(data)
+            except OSError:
+                return
+
+    def close(self):
+        self._closed = True
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_keepalive_teardown_reroutes_inflight_calls():
+    """Freeze the transport a call is in flight on; the client must detect
+    the silence, tear the connection down, and complete the call via the
+    peer's directly-gossiped address well before the request timeout."""
+    host = Rpc("host")
+    host.listen("127.0.0.1:0")
+    host.define("add", lambda a, b: a + b)
+    tcp_addr = next(
+        a for a in host.debug_info()["listen"] if a.startswith("tcp://")
+    )
+    _, hp = tcp_addr[len("tcp://"):].rsplit(":", 1)
+    proxy = StallableProxy("127.0.0.1", int(hp))
+
+    client = Rpc("client")
+    client.set_keepalive_interval(0.25)
+    client.set_timeout(20.0)
+    client.connect(f"127.0.0.1:{proxy.port}")
+    try:
+        assert client.sync("host", "add", 1, 2) == 3  # via proxy
+        proxy.stalled = True
+        t0 = time.monotonic()
+        fut = client.async_("host", "add", 10, 20)
+        assert fut.result(timeout=15) == 30
+        elapsed = time.monotonic() - t0
+        # Rerouted by liveness detection (~4 * 0.25s), not by expiry (20s).
+        assert elapsed < 10.0, f"took {elapsed:.1f}s — not reliably rerouted"
+    finally:
+        client.close()
+        host.close()
+        proxy.close()
+
+
+def test_poke_nack_resends_lost_request():
+    """A request silently lost in transit (written into a dying connection)
+    is recovered: the poke gets a NACK and the client resends."""
+    host = Rpc("host")
+    host.listen("127.0.0.1:0")
+    calls = []
+    host.define("inc", lambda x: (calls.append(x), x + 1)[1])
+
+    client = Rpc("client")
+    client._poke_min = 0.3
+    client.connect(host.debug_info()["listen"][0])
+    try:
+        assert client.sync("host", "inc", 1) == 2  # connection established
+
+        real_write = client._write
+        dropped = []
+
+        async def lossy_write(conn, frames):
+            fid = struct.unpack_from("<I", bytes(frames[0][20:24]))[0]
+            if fid >= FID_USER_BASE and not dropped:
+                dropped.append(fid)
+                return  # lose exactly one user request on the wire
+            await real_write(conn, frames)
+
+        client._write = lossy_write
+        t0 = time.monotonic()
+        fut = client.async_("host", "inc", 41)
+        assert fut.result(timeout=10) == 42
+        elapsed = time.monotonic() - t0
+        assert dropped, "test never exercised the loss path"
+        assert elapsed < 5.0, f"recovered only after {elapsed:.1f}s"
+        assert calls == [1, 41]  # no duplicate execution
+    finally:
+        client._write = real_write
+        client.close()
+        host.close()
+
+
+def test_poke_ack_does_not_duplicate_slow_call():
+    """A slow handler gets poked; the ACK must keep the client waiting
+    without re-executing the request."""
+    host = Rpc("host")
+    host.listen("127.0.0.1:0")
+    calls = []
+
+    def slow(x):
+        calls.append(x)
+        time.sleep(1.5)
+        return x * 2
+
+    host.define("slow", slow)
+    client = Rpc("client")
+    client._poke_min = 0.3
+    client.connect(host.debug_info()["listen"][0])
+    try:
+        assert client.sync("host", "slow", 21) == 42
+        assert calls == [21]
+    finally:
+        client.close()
+        host.close()
+
+
+def test_greeting_name_collision_rejected():
+    host = Rpc("host")
+    host.listen("127.0.0.1:0")
+    host.define("whoami", lambda: "host")
+    addr = host.debug_info()["listen"][0]
+
+    c1 = Rpc("worker")
+    c1.connect(addr)
+    assert c1.sync("host", "whoami") == "host"
+
+    # A second live peer claiming the same name must be rejected, and the
+    # first peer must keep working.
+    c2 = Rpc("worker")
+    c2.set_timeout(1.5)
+    c2.connect(addr)
+    with pytest.raises((RpcError, TimeoutError)):
+        c2.sync("host", "whoami")
+    assert c1.sync("host", "whoami") == "host"
+    c2.close()
+
+    # A restarted incarnation (old peer's connections are gone) is accepted.
+    c1.close()
+    time.sleep(0.2)
+    c3 = Rpc("worker")
+    c3.define("gen", lambda: 3)
+    c3.connect(addr)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            assert host.sync("worker", "gen") == 3
+            break
+        except (RpcError, TimeoutError):
+            time.sleep(0.1)
+    else:
+        pytest.fail("restarted incarnation never accepted")
+    c3.close()
+    host.close()
+
+
+def test_bootid_gates_unix_addresses():
+    host = Rpc("host")
+    host.listen("127.0.0.1:0")
+    unix_addrs = [
+        a for a in host.debug_info()["listen"] if a.startswith("unix:")
+    ]
+    assert unix_addrs, "tcp listen should open a same-host unix socket"
+    addr = unix_addrs[0]
+    assert addr.split(":", 2)[1] == _BOOT_ID  # advertised with boot id
+
+    client = Rpc("client")
+    try:
+        # Same-host (matching boot id): dialable.
+        conn = client._call_soon(client._connect_addr(addr)).result(5)
+        assert conn is not None
+        # Foreign boot id: skipped without a dial even though the path exists.
+        path = addr.split(":", 2)[2]
+        conn = client._call_soon(
+            client._connect_addr(f"unix:not-this-host:{path}")
+        ).result(5)
+        assert conn is None
+    finally:
+        client.close()
+        host.close()
