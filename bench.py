@@ -110,6 +110,15 @@ def main() -> None:
 
     steps_per_sec = iters * T * B / dt
     per_chip = steps_per_sec / max(1, n_chips)
+
+    # MFU: analytic model FLOPs (forward x3 for the backward; convs dominate
+    # ImpalaNet — see moolib_tpu/utils/flops.py) over the chip's peak bf16
+    # throughput. The actionable tuning number: how busy is the MXU.
+    from moolib_tpu.utils.flops import device_peak_flops, impala_train_flops
+
+    flops_per_step = impala_train_flops((T + 1) * B, num_actions=A)
+    achieved = flops_per_step * iters / dt / max(1, n_chips)
+    peak = device_peak_flops(devices[0].device_kind)
     print(
         json.dumps(
             {
@@ -117,6 +126,9 @@ def main() -> None:
                 "value": round(per_chip, 1),
                 "unit": "env-steps/s/chip",
                 "vs_baseline": round(per_chip / NORTH_STAR_PER_CHIP, 3),
+                "mfu": round(achieved / peak, 4) if peak else None,
+                "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
+                "device_kind": devices[0].device_kind,
             }
         )
     )

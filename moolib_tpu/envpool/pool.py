@@ -232,7 +232,9 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
                         continue
                     tail = int(tail_w[0])
                     b = int(slots[tail % _RING])
-                    tail_w[0] = tail + 1
+                    # Explicit u32 wrap: numpy 2.x raises OverflowError on
+                    # out-of-range int assignment instead of wrapping.
+                    tail_w[0] = (tail + 1) & 0xFFFFFFFF
                     if b == _CMD_CLOSE:
                         return
                     step_slice(b)
@@ -489,10 +491,13 @@ class EnvPool:
     def _push_cmd(self, w: int, cmd: int):
         slots, tail = self._rings[w]
         head = self._ring_heads[w]
-        if head - int(tail[0]) >= _RING:
+        # The worker's tail lives in shm as u32 and wraps at 2^32; keep the
+        # head in the same modular space so the occupancy test stays correct
+        # past 2^32 dispatches (_RING divides 2^32, so slot indexing agrees).
+        if (head - int(tail[0])) & 0xFFFFFFFF >= _RING:
             raise RuntimeError("command ring overflow (worker stuck?)")
         slots[head % _RING] = cmd
-        self._ring_heads[w] = head + 1
+        self._ring_heads[w] = (head + 1) & 0xFFFFFFFF
         self._native.sem_post(self._shm.buf, self._ctrl.cmd_sems[w])
 
     def _wait_native(self, batch_index: int, timeout: Optional[float]):

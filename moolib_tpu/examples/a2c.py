@@ -122,6 +122,9 @@ def a2c_loss(params, apply_fn, batch, config):
 
 def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
     """Train A2C on CartPole; returns the list of logged stat rows."""
+    from moolib_tpu.utils import ensure_platforms
+
+    ensure_platforms()  # JAX_PLATFORMS=cpu must never touch a TPU tunnel
     import jax
     import jax.numpy as jnp
     import optax

@@ -59,6 +59,12 @@ class VtraceConfig:
     # learning
     learn_batch_size: int = 32  # envs per learner update (>= actor_batch_size)
     virtual_batch_size: int = 32
+    # DCN pipelining: how many gradient reductions may overlap / queue
+    # unapplied (reference: set_parallel_gradients); 1 = lock-step.
+    parallel_gradients: int = 2
+    # Leader re-pushes full state this often to heal silent drift (reference:
+    # periodic model re-broadcast); None disables.
+    state_broadcast_interval: Optional[float] = 600.0
     learning_rate: float = 6e-4
     grad_clip: float = 40.0
     discounting: float = 0.99
@@ -118,6 +124,9 @@ def _make_model(cfg: VtraceConfig):
 
 
 def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
+    from moolib_tpu.utils import ensure_platforms
+
+    ensure_platforms()  # JAX_PLATFORMS=cpu must never touch a TPU tunnel
     import jax
     import jax.numpy as jnp
     import optax
@@ -195,6 +204,8 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
         virtual_batch_size=cfg.virtual_batch_size,
         get_state=get_state,
         set_state=set_state,
+        parallel_gradients=cfg.parallel_gradients,
+        state_broadcast_interval=cfg.state_broadcast_interval,
     )
 
     ckpt = None

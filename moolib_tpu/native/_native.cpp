@@ -334,7 +334,9 @@ PyObject* decode(ReaderState& r, PyObject* fallback) {
     case T_DICT: {
       uint32_t n;
       if (!r.num(&n)) return truncated();
-      PyObject* d = _PyDict_NewPresized(n);
+      // PyDict_New over the private _PyDict_NewPresized: the presize was a
+      // micro-optimization, but the private API is gone on CPython 3.13+.
+      PyObject* d = PyDict_New();
       if (!d) return nullptr;
       for (uint32_t i = 0; i < n; i++) {
         PyObject* k = decode_guarded(r, fallback);

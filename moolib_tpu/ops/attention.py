@@ -401,6 +401,41 @@ def flash_attention(
     )
 
 
+_flash_probe_ok: Optional[bool] = None
+
+
+def _probe_flash() -> bool:
+    """One-time check that the pallas kernel actually compiles on this TPU.
+
+    'auto' must never hard-fail on first hardware contact: Mosaic can reject
+    a kernel shape (e.g. the (block_q,)-VMEM scratch) at compile time on a
+    backend generation the kernel was never tried on. Probing with a tiny
+    shape at Python level (outside any surrounding jit trace) lets 'auto'
+    degrade to blockwise instead of poisoning the caller's compile.
+    """
+    global _flash_probe_ok
+    if _flash_probe_ok is None:
+        try:
+            # Probe with the dispatcher's DEFAULT block sizes (256x256) and a
+            # multi-block grid — a probe at a different block shape could
+            # pass while the real call still fails, since the failure class
+            # being screened (Mosaic scratch-shape rejection) is
+            # block-shape-dependent. Both causal branches compile.
+            q = jnp.zeros((1, 1, 512, 64), jnp.float32)
+            jax.block_until_ready(flash_attention(q, q, q))
+            jax.block_until_ready(flash_attention(q, q, q, causal=True))
+            _flash_probe_ok = True
+        except Exception as e:  # Mosaic lowering/compile rejection
+            import logging
+
+            logging.getLogger("moolib_tpu.attention").warning(
+                "pallas flash attention unavailable on this backend (%s); "
+                "'auto' will use blockwise", e
+            )
+            _flash_probe_ok = False
+    return _flash_probe_ok
+
+
 def attention(q, k, v, backend: str = "auto", **kw):
     """Dispatcher: 'dense' | 'blockwise' | 'flash' | 'auto' (flash on TPU,
     dense for short sequences, blockwise otherwise)."""
@@ -408,7 +443,12 @@ def attention(q, k, v, backend: str = "auto", **kw):
         Tq, Tk = q.shape[-2], k.shape[-2]
         bq = min(kw.get("block_q", 256), Tq)
         bk = min(kw.get("block_k", 256), Tk)
-        if jax.default_backend() == "tpu" and Tq % bq == 0 and Tk % bk == 0:
+        if (
+            jax.default_backend() == "tpu"
+            and Tq % bq == 0
+            and Tk % bk == 0
+            and _probe_flash()
+        ):
             backend = "flash"
         elif Tq * Tk <= 1024 * 1024:
             backend = "dense"

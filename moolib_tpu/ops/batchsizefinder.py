@@ -6,9 +6,15 @@ latency-penalized throughput objective and refines around the best; the
 reference ships it as dead code, here it is live and tested).
 
 TPU rationale: throughput rises with batch size until the MXU saturates,
-then latency grows linearly and throughput plateaus (measured on the
-IMPALA learner: 1.6M steps/s at B=32 -> 4.2M at B=128 on one v5e chip).
-``find_batch_size`` locates that knee empirically for any jitted step.
+then latency grows linearly and throughput plateaus. ``find_batch_size``
+locates that knee empirically for any jitted step.
+
+Timing protocol: each measurement ends in a device-to-host readback of a
+scalar derived from the last output (the same protocol as bench.py) — on
+remote-device runtimes even ``block_until_ready`` can return before device
+execution finishes, but a D2H value transfer cannot be faked, and the
+runtime executes dispatches in order, so reading the last output bounds
+all ``iters`` calls.
 """
 
 from __future__ import annotations
@@ -17,6 +23,7 @@ import time
 from typing import Callable, List, Optional, Tuple
 
 import jax
+import numpy as np
 
 from ..utils import get_logger
 
@@ -38,6 +45,15 @@ class Measurement(tuple):
     throughput = property(lambda s: s[2])
 
 
+def _readback(out) -> None:
+    """Force real completion of all dispatched work via a D2H scalar pull."""
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0):
+            np.asarray(jax.device_get(leaf.ravel()[0]))
+            return
+    jax.block_until_ready(out)  # no array leaves: best effort
+
+
 def _measure(fn: Callable, make_inputs: Callable, bs: int,
              warmup: int, iters: int) -> float:
     args = make_inputs(bs)
@@ -45,11 +61,11 @@ def _measure(fn: Callable, make_inputs: Callable, bs: int,
         args = (args,)
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _readback(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _readback(out)
     return (time.perf_counter() - t0) / iters
 
 

@@ -79,19 +79,38 @@ def ring_attention(
         kb, vb, segb, m, l, acc = carry
         # The shard we hold at step i originated on device (idx - i) mod n.
         src = (idx - i) % n
-        bias = None
+
+        def fold(mla):
+            m, l, acc = mla
+            bias = None
+            if causal:
+                kpos = src * T + jnp.arange(T)
+                bias = jnp.where(
+                    qpos[:, None] >= kpos[None, :], 0.0, -1e30
+                )  # [T, T]
+            if use_seg:
+                same = seg_q[:, None, :, None] == segb[:, None, None, :]
+                seg_bias = jnp.where(same, 0.0, -1e30)
+                bias = seg_bias if bias is None else bias + seg_bias
+            return _online_block(
+                qf, kb.astype(jnp.float32), vb.astype(jnp.float32),
+                bias, m, l, acc,
+            )
+
         if causal:
-            kpos = src * T + jnp.arange(T)
-            bias = jnp.where(
-                qpos[:, None] >= kpos[None, :], 0.0, -1e30
-            )  # [T, T]
-        if use_seg:
-            same = seg_q[:, None, :, None] == segb[:, None, None, :]
-            seg_bias = jnp.where(same, 0.0, -1e30)
-            bias = seg_bias if bias is None else bias + seg_bias
-        m, l, acc = _online_block(
-            qf, kb.astype(jnp.float32), vb.astype(jnp.float32), bias, m, l, acc
-        )
+            # Causal step skipping: a shard from a strictly-later device is
+            # fully masked (min kpos = src*T > max qpos = idx*T + T - 1), so
+            # folding it is pure wasted FLOPs — skip via cond. The K/V
+            # rotation below does NOT depend on the fold, so XLA can run the
+            # ring ahead of compute and device idx pays for only idx+1 folds
+            # (~2x average causal throughput; the last ring device still
+            # folds all n shards, so perfectly load-balanced causal sharding
+            # would need striped token layouts).
+            m, l, acc = jax.lax.cond(
+                src <= idx, fold, lambda mla: mla, (m, l, acc)
+            )
+        else:
+            m, l, acc = fold((m, l, acc))
         # Rotate K/V (and key segments) one step around the ring.
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)

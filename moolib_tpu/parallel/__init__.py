@@ -9,6 +9,12 @@ from .mesh import (
     replicated_spec,
     shard_batch,
 )
+from .tp import (
+    impala_tp_specs,
+    shard_params,
+    sharded_init_opt_state,
+    transformer_tp_specs,
+)
 
 __all__ = [
     "Accumulator",
@@ -20,4 +26,8 @@ __all__ = [
     "pmean_gradients",
     "dp_average_grads",
     "shard_batch",
+    "impala_tp_specs",
+    "shard_params",
+    "sharded_init_opt_state",
+    "transformer_tp_specs",
 ]

@@ -16,8 +16,8 @@ TPU-native division of labor:
   pytrees (numpy leaves) over the RPC tree allreduce with the reference's
   virtual-batch-size semantics and elastic membership.
 
-Round protocol (lock-step, stall-free): every member's ``update()`` drives
-small *count rounds* continuously — each round sums (batch_size, n_grads)
+Round protocol (stall-free): every member's ``update()`` drives small
+*count rounds* continuously — each round sums (batch_size, n_grads)
 contributed since the last round (zero for idle/unsynced peers, the
 built-in equivalent of ``skip_gradients``). All peers observe identical
 count totals, so when the cumulative count crosses ``virtual_batch_size``
@@ -25,6 +25,24 @@ every peer deterministically joins the same *gradient round*, shipping its
 accumulated local gradient sum (or None). The reduced sum is divided by the
 total sample count and surfaced via ``has_gradients()``/
 ``result_gradients()``.
+
+Pipelining (``parallel_gradients`` > 1, reference:
+set_parallel_gradients / the in-flight reduction ring,
+src/accumulator.cc:251-256): count rounds keep running while gradient
+rounds are still reducing, and up to ``parallel_gradients`` reduced
+results may queue unapplied — so one DCN round-trip of latency overlaps
+with the next virtual batch's compute instead of serializing into it.
+Gradient-round *starts* remain deterministic (they are triggered inside
+count-round completions, which are totally ordered), and results are
+released to the user strictly in round order even when the underlying
+reductions complete out of order.
+
+Drift healing (reference: periodic leader buffer/model re-broadcast,
+src/accumulator.cc:761-795): the leader re-pushes its full state to every
+member each ``state_broadcast_interval`` seconds; members apply it when
+they have nothing unapplied locally. A peer whose params drifted (missed
+round, fp divergence) converges back to the leader's canonical copy
+without ever requesting a resync.
 
 Gradient convention: ``reduce_gradients(grads, batch_size)`` expects
 **batch-sum** gradients (mean-gradient * batch_size); the result handed
@@ -35,6 +53,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -95,6 +114,8 @@ class Accumulator:
         get_state: Optional[Callable[[], Any]] = None,
         set_state: Optional[Callable[[Any], None]] = None,
         timeout: float = 10.0,
+        parallel_gradients: int = 1,
+        state_broadcast_interval: Optional[float] = 600.0,
     ):
         self.rpc = rpc
         self.group = group or Group(
@@ -117,8 +138,15 @@ class Accumulator:
         self._attempt = 0                        # retry suffix for count keys
         self._gseq = 0                           # gradient-round sequence
         self._round_inflight = False
-        self._grad_inflight = False
+        self._grads_inflight = 0                 # concurrent gradient rounds
         self._cumulative_bs = 0                  # global, same on all peers
+        self._parallel = max(1, int(parallel_gradients))
+        # Out-of-order completions park here until released in gseq order.
+        self._grad_outcomes: Dict[int, Optional[Tuple[Any, int]]] = {}
+        self._release_gseq = 0
+        self._broadcast_interval = state_broadcast_interval
+        self._last_broadcast = time.monotonic()
+        self._applying_push = False  # pauses result release during a push
 
         self._pending_bundle = None              # user grads since last round
         self._pending_bs = 0
@@ -127,12 +155,16 @@ class Accumulator:
         self._committed_bs = 0
         self._committed_ngrads = 0
 
-        self._result: Optional[Tuple[Any, int]] = None  # (mean grads, count)
+        # Released results in round order: (mean grads, count, version_after).
+        self._results: deque = deque()
         self._result_version = 0  # model version the latest result produces
         self._user_has_contributed = False
 
         rpc.define(
             "AccumulatorService::requestState", self._serve_state
+        )
+        rpc.define(
+            "AccumulatorService::pushState", self._on_push_state
         )
 
     # -- reference-parity introspection --------------------------------------
@@ -154,24 +186,37 @@ class Accumulator:
     def connected(self) -> bool:
         return self.group.active() and self._leader is not None
 
+    def set_parallel_gradients(self, n: int):
+        """Allow up to ``n`` gradient reductions in flight / unapplied
+        (reference: set_parallel_gradients, src/moolib.cc)."""
+        if n < 1:
+            raise ValueError("parallel_gradients must be >= 1")
+        with self._lock:
+            self._parallel = int(n)
+
     def wants_gradients(self) -> bool:
         with self._lock:
             return (
                 self.connected()
                 and self._synced
-                and self._result is None
+                # In-flight reductions count against the cap too — otherwise
+                # a fast producer over a slow DCN piles up unbounded overlap
+                # (and unbounded gradient staleness).
+                and len(self._results) + self._grads_inflight < self._parallel
                 and not self._user_has_contributed
             )
 
     def has_gradients(self) -> bool:
-        return self._result is not None
+        return bool(self._results)
 
     def result_gradients(self) -> Tuple[Any, int]:
-        """-> (mean gradient pytree, virtual batch count)."""
+        """-> (mean gradient pytree, virtual batch count) for the OLDEST
+        unapplied round; ``zero_gradients`` consumes it."""
         with self._lock:
-            if self._result is None:
+            if not self._results:
                 raise RpcError("no reduced gradients available")
-            return self._result
+            mean, count, _version = self._results[0]
+            return mean, count
 
     def result_model_version(self) -> int:
         """Model version that applying the current (or most recent) reduced
@@ -179,6 +224,8 @@ class Accumulator:
         concurrently between ``has_gradients()`` and a later read, so it is
         the right label for checkpoints of just-updated params."""
         with self._lock:
+            if self._results:
+                return self._results[0][2]
             return self._result_version
 
     # -- user contributions ---------------------------------------------------
@@ -201,9 +248,11 @@ class Accumulator:
             self._user_has_contributed = True
 
     def zero_gradients(self):
-        """Consume the reduced result; re-enables wants_gradients."""
+        """Consume the oldest reduced result; re-enables wants_gradients."""
         with self._lock:
-            self._result = None
+            if self._results:
+                _mean, _count, version = self._results.popleft()
+                self._result_version = version
             self._user_has_contributed = False
 
     # -- heartbeat ------------------------------------------------------------
@@ -224,9 +273,13 @@ class Accumulator:
             if not self._synced:
                 self._maybe_request_state()
             # Drive one count round at a time; unsynced/idle peers
-            # contribute zeros so collectives never stall.
-            if not self._round_inflight and not self._grad_inflight:
+            # contribute zeros so collectives never stall. With pipelining,
+            # counting continues while gradient rounds are still reducing.
+            if not self._round_inflight and (
+                self._parallel > 1 or self._grads_inflight == 0
+            ):
                 self._start_count_round()
+        self._maybe_broadcast_state()  # outside the lock: get_state may be slow
 
     # -- epoch / election -----------------------------------------------------
 
@@ -241,7 +294,9 @@ class Accumulator:
         self._attempt = 0
         self._gseq = 0
         self._round_inflight = False
-        self._grad_inflight = False
+        self._grads_inflight = 0
+        self._grad_outcomes.clear()
+        self._release_gseq = 0
         self._cumulative_bs = 0
         # Pending user grads survive a resync; committed ones were bound to
         # the old epoch's (now discarded) counts and merge back into pending
@@ -306,10 +361,10 @@ class Accumulator:
             raise RpcError("no get_state callback configured")
         with self._lock:
             # _model_version bumps when a reduced result becomes available,
-            # BEFORE the user applies it; the params get_state() sees then
-            # are still the previous version. Serve the version that matches
-            # the state actually handed out.
-            version = self._model_version - (1 if self._result is not None else 0)
+            # BEFORE the user applies it; the params get_state() sees still
+            # lack every unapplied queued result. Serve the version that
+            # matches the state actually handed out.
+            version = self._model_version - len(self._results)
             state = _to_numpy_tree(self._get_state())
         return {"state": state, "model_version": version}
 
@@ -345,6 +400,69 @@ class Accumulator:
             leader, "AccumulatorService::requestState", on_state
         )
 
+    def _maybe_broadcast_state(self):
+        """Leader-side periodic full-state re-push to every member
+        (reference: the 12s buffer / 600s model re-broadcast,
+        src/accumulator.cc:761-795). Heals silent drift — a peer whose
+        params diverged converges back without requesting anything."""
+        if self._broadcast_interval is None or self._get_state is None:
+            return
+        with self._lock:
+            if not self.is_leader() or not self._synced:
+                return
+            now = time.monotonic()
+            if now - self._last_broadcast < self._broadcast_interval:
+                return
+            self._last_broadcast = now
+            members = [
+                m for m in self.group.members if m != self.rpc.get_name()
+            ]
+            if not members:
+                return
+            # State and its version label must be read atomically (same rule
+            # as _serve_state): a result applied between the two reads would
+            # mislabel the broadcast one version low.
+            version = self._model_version - len(self._results)
+            payload = {
+                "state": _to_numpy_tree(self._get_state()),
+                "model_version": version,
+            }
+        for m in members:
+            self.rpc.async_callback(
+                m, "AccumulatorService::pushState",
+                lambda _r, _e: None,  # best effort; next interval retries
+                payload,
+            )
+
+    def _on_push_state(self, payload):
+        """Member-side application of a leader state push."""
+        if self._set_state is None:
+            return False
+        with self._lock:
+            version = int(payload["model_version"])
+            if self.is_leader() or self._applying_push:
+                return False
+            # Only apply when nothing released-but-unapplied is queued
+            # locally: those updates are already inside a newer leader state,
+            # and applying both would double-count them.
+            if self._results or version < self._model_version:
+                return False
+            # Freeze result release for the duration of the (slow, outside
+            # the lock) apply: a result released + applied by the training
+            # thread mid-apply would be silently clobbered by this push.
+            self._applying_push = True
+        try:
+            self._set_state(payload["state"])  # outside the lock: device_put
+        finally:
+            with self._lock:
+                self._applying_push = False
+                if version >= self._model_version:
+                    self._model_version = version
+                    self._result_version = version
+                    self._synced = True
+                self._release_ready_locked()  # drain anything parked
+        return True
+
     # -- reduce rounds ---------------------------------------------------------
 
     def _start_count_round(self):
@@ -354,7 +472,10 @@ class Accumulator:
         # the round SUCCEEDS (a failed round's counts never reached the
         # cluster, so its gradients must not enter a later grad round with
         # an unreported sample count).
-        if self._synced and self._result is None:
+        if (
+            self._synced
+            and len(self._results) + self._grads_inflight < self._parallel
+        ):
             snap_bundle = self._pending_bundle
             snap_bs = self._pending_bs
             snap_ng = self._pending_ngrads
@@ -423,18 +544,48 @@ class Accumulator:
             return
         fut.add_done_callback(done)
 
+    def _release_ready_locked(self):
+        """Release contiguous settled rounds to the user, in gseq order.
+        Paused while a leader state push is being applied (_applying_push):
+        a result released mid-apply could be applied by the training thread
+        and then silently clobbered by the older pushed state."""
+        if self._applying_push:
+            return
+        while self._release_gseq in self._grad_outcomes:
+            out = self._grad_outcomes.pop(self._release_gseq)
+            self._release_gseq += 1
+            if out is None:
+                continue  # failed round or nobody contributed
+            self._model_version += 1
+            # Third element: version of the params a user holds AFTER
+            # applying this result — lets callers label checkpoints
+            # race-free while _model_version keeps moving on RPC threads.
+            self._results.append((out[0], out[1], self._model_version))
+
     def _start_grad_round(self, count: int):
         """All peers enter deterministically once counts cross the virtual
-        batch size (reference: startReduce, src/accumulator.cc:1005-1033)."""
+        batch size (reference: startReduce, src/accumulator.cc:1005-1033).
+
+        The round key (gseq) is claimed at START — grad-round starts are
+        triggered inside count-round completions, which are totally ordered,
+        so keys agree across peers even with several rounds in flight.
+        """
         epoch = self._epoch
         gseq = self._gseq
+        self._gseq = gseq + 1
         bundle = self._committed_bundle
         ngrads = self._committed_ngrads
         self._committed_bundle = None
         self._committed_bs = 0
         self._committed_ngrads = 0
-        self._grad_inflight = True
+        self._grads_inflight += 1
         self._cumulative_bs = 0
+
+        def settle_locked(outcome):
+            """Park this round's outcome, release any now-contiguous ones."""
+            self._grads_inflight -= 1
+            self._grad_outcomes[gseq] = outcome
+            self._release_ready_locked()
 
         def done(fut):
             try:
@@ -442,8 +593,7 @@ class Accumulator:
             except Exception as e:
                 with self._lock:
                     if self._epoch == epoch:
-                        self._grad_inflight = False
-                        self._gseq = gseq + 1
+                        settle_locked(None)
                         # Peers that completed this round applied an update we
                         # missed: our params are now stale. Force a state
                         # re-request from the leader instead of training on.
@@ -454,31 +604,22 @@ class Accumulator:
             with self._lock:
                 if self._epoch != epoch:
                     return
-                self._grad_inflight = False
-                self._gseq = gseq + 1
                 if total_bundle is None:
-                    return  # nobody contributed
+                    settle_locked(None)  # nobody contributed
+                    return
                 mean = nest.map_structure(
                     lambda x: x / count, total_bundle
                 )
-                self._result = (mean, count)
-                self._model_version += 1
-                # Version of the params a user will hold AFTER applying this
-                # result — lets callers label checkpoints race-free while
-                # _model_version keeps moving on RPC threads.
-                self._result_version = self._model_version
+                settle_locked((mean, count))
 
         try:
             fut = self.group.all_reduce(
                 f"acc.grads.{gseq}", (bundle, ngrads), op=_grad_merge
             )
         except RpcError:
-            # Mirror the async-failure path: peers whose round failed in
-            # flight advance to gseq+1, so a synchronous failure must too —
-            # otherwise this peer issues acc.grads.{gseq} keys one round
-            # behind the cluster for the rest of the epoch.
-            self._grad_inflight = False
-            self._gseq = gseq + 1
+            # Mirror the async-failure path so this peer's release cursor
+            # doesn't fall permanently behind the cluster's round keys.
+            settle_locked(None)
             if self._set_state is not None and not self.is_leader():
                 self._synced = False
             return

@@ -33,7 +33,7 @@ from typing import Dict
 
 from ..rpc import Group, RpcError
 from ..utils import get_logger
-from ..utils.stats import StatMax, Stats
+from ..utils.stats import StatMax, StatMean, StatSum, Stats
 
 log = get_logger("stats")
 
@@ -53,12 +53,16 @@ def _kind_of(stat) -> str:
     return type(stat).__name__  # StatSum | StatMean | StatMax | ...
 
 
+# Wire kind tag -> class. An explicit whitelist: the tag arrives from remote
+# peers, so it must never be resolved via getattr on a module (that would let
+# a peer instantiate arbitrary module attributes).
+_STAT_KINDS = {cls.__name__: cls for cls in (StatSum, StatMean, StatMax)}
+
+
 def _stat_from_kind(kind: str):
     """Instantiate a zeroed stat from its wire kind tag, so keys tracked
     only by remote peers still appear in the global view."""
-    from ..utils import stats as stats_mod
-
-    cls = getattr(stats_mod, kind, None)
+    cls = _STAT_KINDS.get(kind)
     if cls is None:
         return None
     return _zeroed(cls())

@@ -1,0 +1,114 @@
+"""Tensor parallelism: Megatron-style sharded parameters over the ``tp`` axis.
+
+The reference has no tensor parallelism at all (its model parallelism story
+is "buy a bigger GPU"; SURVEY.md §5) — this is TPU-first scope. The design
+follows XLA's GSPMD model rather than hand-written sharded layers:
+
+- parameters get *placements* (``NamedSharding`` over the mesh's ``tp``
+  axis) chosen by the classic Megatron pattern — attention qkv and MLP
+  up-projections column-parallel ``P(None, 'tp')``, attention out and MLP
+  down-projections row-parallel ``P('tp', None)``;
+- the train/forward step itself is the ordinary *unsharded* jitted
+  function: under jit, XLA propagates the operand shardings through the
+  whole computation and inserts the matching collectives (all-reduce after
+  row-parallel matmuls, all-gather where layouts change, the dp gradient
+  reduction) automatically.
+
+So "turning on tp" is pure data placement — no model code changes, no
+shard_map, and composition with dp/sp falls out of the mesh shape. This is
+the how-to-scale-your-model recipe: pick a mesh, annotate shardings, let
+XLA insert collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "transformer_tp_specs",
+    "impala_tp_specs",
+    "shard_params",
+    "sharded_init_opt_state",
+]
+
+# Column-parallel: kernel [in, out] splits the OUTPUT features; its bias
+# splits with them. Row-parallel: kernel splits the INPUT features (the
+# matmul produces partial sums XLA all-reduces); bias stays replicated.
+_COL_KERNEL = P(None, "tp")
+_ROW_KERNEL = P("tp", None)
+_COL_BIAS = P("tp")
+
+
+def _path_names(path) -> list:
+    return [getattr(k, "key", str(k)) for k in path]
+
+
+def transformer_tp_specs(params, axis: str = "tp") -> Any:
+    """PartitionSpec pytree for ``TransformerNet`` params.
+
+    qkv -> column, attn out -> row, MLP up (``Dense_0`` in ``_Block``) ->
+    column, MLP down (``Dense_1``) -> row; embeddings, norms, heads, and
+    the conv torso replicate.
+    """
+
+    def spec(path, leaf):
+        names = _path_names(path)
+        inside_block = any(n.startswith("block_") for n in names)
+        if "qkv" in names:
+            return _rename(_COL_KERNEL, axis)
+        if "out" in names and names[-1] == "kernel":
+            return _rename(_ROW_KERNEL, axis)
+        if inside_block and "Dense_0" in names:
+            return _rename(
+                _COL_KERNEL if names[-1] == "kernel" else _COL_BIAS, axis
+            )
+        if inside_block and "Dense_1" in names and names[-1] == "kernel":
+            return _rename(_ROW_KERNEL, axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def impala_tp_specs(params, axis: str = "tp") -> Any:
+    """PartitionSpec pytree for ``ImpalaNet`` params: the big flatten->hidden
+    projection (``Dense_0``) is column-parallel, the policy/baseline heads
+    (``Dense_1``/``Dense_2``) row-parallel; convs and LSTM replicate (their
+    channel counts are too small to pay for collectives on TPU)."""
+
+    def spec(path, leaf):
+        names = _path_names(path)
+        if "Dense_0" in names:
+            return _rename(
+                _COL_KERNEL if names[-1] == "kernel" else _COL_BIAS, axis
+            )
+        if ("Dense_1" in names or "Dense_2" in names) and names[-1] == "kernel":
+            return _rename(_ROW_KERNEL, axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def _rename(spec: P, axis: str) -> P:
+    if axis == "tp":
+        return spec
+    return P(*(axis if s == "tp" else s for s in spec))
+
+
+def shard_params(mesh: Mesh, params, specs) -> Any:
+    """Place a parameter pytree onto the mesh per its spec pytree."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def sharded_init_opt_state(optimizer, sharded_params):
+    """Initialize optimizer state with shardings inherited from the params.
+
+    Running ``optimizer.init`` under jit with already-sharded params makes
+    XLA propagate each param's sharding onto its momentum/second-moment
+    slots (and replicate scalars) — no per-optimizer spec plumbing.
+    """
+    return jax.jit(optimizer.init)(sharded_params)

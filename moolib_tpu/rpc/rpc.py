@@ -255,7 +255,10 @@ class Queue:
                 )
                 if remaining is not None and remaining <= 0:
                     raise TimeoutError("Queue.get timed out")
-                self._cond.wait(timeout=0.05 if remaining is None else min(0.05, remaining))
+                # No periodic poll needed: only _push (notifies) or _close
+                # (notifies) can change _pop_locked's outcome — expired
+                # entries alone never make a new batch poppable.
+                self._cond.wait(timeout=remaining)
 
     async def get_async(self):
         loop = asyncio.get_running_loop()
@@ -268,11 +271,9 @@ class Queue:
                 if self._closed:
                     raise RpcError(f"queue {self.name!r} closed")
                 self._async_waiters.append((loop, event))
-            try:
-                # Woken by _push; the 0.25s cap re-checks expiry and close.
-                await asyncio.wait_for(event.wait(), timeout=0.25)
-            except asyncio.TimeoutError:
-                pass
+            # Woken by _push or _close (both signal registered waiters);
+            # nothing else can change _pop_locked's outcome, so no timeout.
+            await event.wait()
 
     def __aiter__(self):
         return self
@@ -860,13 +861,19 @@ class Rpc:
         for addr in list(peer.addresses):
             if peer.conns:
                 return
+            if peer.found_event is None or peer.found_event.is_set():
+                peer.found_event = asyncio.Event()
             conn = await self._connect_addr(addr)
             if conn is not None:
-                # Greeting exchange will bind it to the peer.
-                for _ in range(100):
-                    if peer.conns:
-                        return
-                    await asyncio.sleep(0.01)
+                # The greeting exchange binds the conn to the peer and sets
+                # found_event (_on_greeting); await it instead of polling.
+                # Timeout covers a peer that accepts but never greets.
+                try:
+                    await asyncio.wait_for(peer.found_event.wait(), timeout=2.0)
+                except asyncio.TimeoutError:
+                    continue  # next address
+                if peer.conns:
+                    return
 
     # -- requests (server side) ---------------------------------------------
 

@@ -5,6 +5,7 @@ not pay JAX initialization cost (see moolib_tpu/__init__.py)."""
 import importlib
 
 from .checkpoint import Checkpointer, load_checkpoint, save_checkpoint
+from .jaxenv import ensure_platforms
 from .logging import get_logger, set_log_level, set_logging
 from .stats import StatMax, StatMean, StatSum, Stats
 from .timer import Ewma, Timer

@@ -1,0 +1,112 @@
+"""Analytic FLOPs accounting and MFU (model FLOPs utilization) reporting.
+
+The reference never reports FLOPs — its perf story is env-steps/s alone
+(reference: README.md:34-37 qualitative scaling claim). On TPU the actionable
+perf question is "how busy is the MXU", so the benchmark reports MFU:
+achieved model FLOP/s divided by the chip's peak. FLOPs are counted
+analytically from the architecture (convolutions dominate ImpalaNet; the
+V-trace scan, optimizer update, and normalization are O(params) or O(T*B)
+elementwise and contribute <1% — they are deliberately excluded so the
+number is a *model* FLOPs utilization, comparable across implementations).
+
+Convention: a MAC counts as 2 FLOPs. A training step costs 3x the forward
+pass (one forward, ~2x forward for the backward's two matmul-shaped products
+per layer).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+__all__ = [
+    "conv2d_flops",
+    "dense_flops",
+    "lstm_flops",
+    "impala_forward_flops",
+    "impala_train_flops",
+    "device_peak_flops",
+    "TRAIN_FLOPS_MULTIPLIER",
+]
+
+# fwd + backward(dL/dx + dL/dW) — each backward product is matmul-shaped with
+# the same FLOP count as the forward contraction.
+TRAIN_FLOPS_MULTIPLIER = 3
+
+
+def conv2d_flops(h_out: int, w_out: int, kh: int, kw: int, c_in: int, c_out: int) -> int:
+    """FLOPs for one conv2d application on a single image (2 * MACs)."""
+    return 2 * h_out * w_out * kh * kw * c_in * c_out
+
+
+def dense_flops(d_in: int, d_out: int) -> int:
+    return 2 * d_in * d_out
+
+
+def lstm_flops(d_in: int, hidden: int) -> int:
+    """FLOPs for one LSTM cell step on one sample: 4 gates, two matmuls each."""
+    return 2 * 4 * hidden * (d_in + hidden)
+
+
+def impala_forward_flops(
+    height: int = 84,
+    width: int = 84,
+    in_channels: int = 4,
+    channels: Sequence[int] = (16, 32, 32),
+    hidden_size: int = 256,
+    num_actions: int = 6,
+    use_lstm: bool = False,
+    lstm_size: int = 256,
+) -> int:
+    """Forward FLOPs per frame for ImpalaNet (models/impala.py).
+
+    Mirrors the architecture exactly: per ConvSequence one 3x3 conv at the
+    incoming resolution, a stride-2 SAME max-pool, then two residual blocks
+    (four 3x3 convs) at the pooled resolution. 84x84 input pools 84→42→21→11.
+    """
+    h, w, c = height, width, in_channels
+    total = 0
+    for ch in channels:
+        total += conv2d_flops(h, w, 3, 3, c, ch)
+        h, w = math.ceil(h / 2), math.ceil(w / 2)  # SAME pool, stride 2
+        total += 4 * conv2d_flops(h, w, 3, 3, ch, ch)
+        c = ch
+    total += dense_flops(h * w * c, hidden_size)
+    if use_lstm:
+        total += lstm_flops(hidden_size, lstm_size)
+        hidden_size = lstm_size
+    total += dense_flops(hidden_size, num_actions)  # policy head
+    total += dense_flops(hidden_size, 1)  # baseline head
+    return total
+
+
+def impala_train_flops(frames: int, **kw) -> int:
+    """Total model FLOPs for one train step consuming ``frames`` frames
+    (= (T+1) * B forward frames; the bootstrap frame is real compute)."""
+    return TRAIN_FLOPS_MULTIPLIER * frames * impala_forward_flops(**kw)
+
+
+# Peak dense matmul throughput per chip, bf16, FLOP/s. Public numbers from
+# cloud.google.com/tpu/docs (per-chip; a jax device is one chip on v4+, one
+# core on v2/v3).
+_PEAK_BF16 = (
+    ("v5 lite", 197e12),  # v5e
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v6 lite", 918e12),  # v6e / Trillium
+    ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),  # bare "TPU v5" = v5p
+    ("v4", 275e12),
+    ("v3", 61.4e12),  # per core
+    ("v2", 22.8e12),
+)
+
+
+def device_peak_flops(device_kind: str) -> Optional[float]:
+    """Peak bf16 FLOP/s for a jax ``device_kind`` string, or None if unknown."""
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None

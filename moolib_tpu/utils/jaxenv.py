@@ -1,0 +1,30 @@
+"""JAX platform-selection guard for entry points.
+
+Some deployment environments install a PJRT plugin whose registration hook
+initializes its (possibly remote) backend from ``jax.backends()`` even when
+``JAX_PLATFORMS`` restricts the platform list — so a CPU-only subprocess can
+block on an unreachable accelerator tunnel during ``jax.devices()``.
+Mirroring the env var into ``jax.config`` before first backend access makes
+the restriction authoritative. Every CLI entry point that touches jax calls
+:func:`ensure_platforms` first; library code never needs to.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["ensure_platforms"]
+
+
+def ensure_platforms() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative via ``jax.config``. No-op when
+    the env var is unset or backends are already initialized."""
+    value = os.environ.get("JAX_PLATFORMS")
+    if not value:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", value)
+    except Exception:
+        pass  # backends already up: the env var did its job (or never will)

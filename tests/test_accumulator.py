@@ -165,3 +165,93 @@ def test_peer_death_recovery(cluster):
     _pump(accs, lambda: all(a.has_gradients() for a in accs), timeout=30)
     mean, count = accs[0].result_gradients()
     assert count == 2
+
+
+def test_parallel_gradients_pipelining(cluster):
+    """With parallel_gradients=2, a second virtual batch reduces while the
+    first result is still unapplied, and results release in round order
+    (reference: the in-flight reduction ring, src/accumulator.cc:251-256)."""
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=2, parallel_gradients=2)
+            for i in range(2)]
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients() for a in accs))
+
+    # Round 0: both contribute ones.
+    for a in accs:
+        a.reduce_gradients({"g": np.ones(2)}, batch_size=1)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+
+    # Do NOT apply/zero yet — contribute the next round on top (this is the
+    # pipelined window: wants_gradients re-opens with a result still queued).
+    _pump(accs, lambda: all(a.wants_gradients() for a in accs))
+    for a in accs:
+        a.reduce_gradients({"g": np.full(2, 3.0)}, batch_size=1)
+
+    # Both rounds must complete with the first still unapplied.
+    def two_results():
+        return all(len(a._results) == 2 for a in accs)
+    _pump(accs, two_results, timeout=30)
+
+    for a in accs:
+        mean0, count0 = a.result_gradients()
+        np.testing.assert_allclose(mean0["g"], np.ones(2))  # round 0 first
+        assert count0 == 2
+        a.zero_gradients()
+        mean1, count1 = a.result_gradients()
+        np.testing.assert_allclose(mean1["g"], np.full(2, 3.0))
+        assert count1 == 2
+        a.zero_gradients()
+    assert accs[0].model_version == accs[1].model_version >= 2
+
+
+def test_parallel_gradients_survives_churn(cluster):
+    """Two overlapped rounds + a peer joining mid-flight: the epoch reset
+    cancels cleanly and the survivors' contributions are re-reduced."""
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=2, parallel_gradients=2)
+            for i in range(2)]
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients() for a in accs))
+    for a in accs:
+        a.reduce_gradients({"g": np.ones(1)}, batch_size=1)
+    # Churn while rounds may be in flight: a third peer joins -> new epoch.
+    accs.append(_spawn_acc(cluster, "late", vbs=2, parallel_gradients=2))
+    _pump(accs, lambda: all(a.connected() for a in accs), timeout=30)
+    # Every peer eventually gets a result (possibly re-reduced after reset).
+    _pump(accs, lambda: all(a.has_gradients() or a.wants_gradients()
+                            for a in accs), timeout=30)
+    for a in accs:
+        if a.wants_gradients():
+            a.reduce_gradients({"g": np.ones(1)}, batch_size=1)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs), timeout=30)
+    mean, count = accs[0].result_gradients()
+    assert count >= 2
+    assert np.isfinite(mean["g"]).all()
+
+
+def test_leader_broadcast_heals_drift(cluster):
+    """The leader's periodic state push overwrites a drifted member's params
+    without the member requesting anything (reference:
+    src/accumulator.cc:761-795 periodic buffer/model re-broadcast)."""
+    leader_state = {"w": np.arange(4.0)}
+    leader = _spawn_acc(
+        cluster, "leader", vbs=2,
+        get_state=lambda: leader_state,
+        state_broadcast_interval=0.3,
+    )
+    leader.set_model_version(3)
+
+    member_state = {}
+    member = _spawn_acc(
+        cluster, "member", vbs=2,
+        set_state=lambda s: member_state.update(s),
+        state_broadcast_interval=0.3,
+    )
+    accs = [leader, member]
+    _pump(accs, lambda: all(a.connected() for a in accs)
+          and member.get_gradient_stats()["synced"])
+    np.testing.assert_array_equal(member_state["w"], leader_state["w"])
+
+    # Drift: corrupt the member's copy; it must heal on the next broadcast
+    # tick with no resync request and no version change.
+    member_state["w"] = np.full(4, -99.0)
+    _pump(accs, lambda: np.array_equal(member_state["w"], leader_state["w"]),
+          timeout=15)
+    assert member.model_version == 3

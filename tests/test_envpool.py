@@ -94,3 +94,43 @@ def test_envpool_device_staging(rng):
 
 def test_envstepper_alias():
     assert EnvStepper is EnvPool
+
+
+def test_push_cmd_ring_wraparound():
+    """The parent's head and the worker's shm tail (u32) must agree past
+    2^32 dispatches: occupancy is computed in modular space (regression for
+    a spurious 'command ring overflow' after 2^32 steps)."""
+    import types
+
+    from moolib_tpu.envpool import pool as pool_mod
+
+    posts = []
+    fake = types.SimpleNamespace(
+        _rings=[(np.zeros(pool_mod._RING, np.uint32),
+                 np.zeros(1, np.uint32))],
+        _ring_heads=[0],
+        _native=types.SimpleNamespace(
+            sem_post=lambda buf, off: posts.append(off)
+        ),
+        _shm=types.SimpleNamespace(buf=None),
+        _ctrl=types.SimpleNamespace(cmd_sems=[0]),
+    )
+    push = pool_mod.EnvPool._push_cmd
+
+    # Park head/tail just below the u32 wrap, as after ~2^32 dispatches.
+    start = 2**32 - 3
+    fake._ring_heads[0] = start % 2**32
+    fake._rings[0][1][0] = start % 2**32
+    for i in range(8):  # crosses the wrap boundary
+        push(fake, 0, i % pool_mod._RING)
+        # Worker consumed it: advance the shm tail with u32 wrap semantics.
+        fake._rings[0][1][0] = (int(fake._rings[0][1][0]) + 1) & 0xFFFFFFFF
+    assert len(posts) == 8
+    assert fake._ring_heads[0] == (start + 8) % 2**32
+
+    # And a genuinely full ring still trips the overflow guard.
+    fake._rings[0][1][0] = fake._ring_heads[0]
+    for i in range(pool_mod._RING):
+        push(fake, 0, 0)
+    with pytest.raises(RuntimeError, match="overflow"):
+        push(fake, 0, 0)

@@ -1,0 +1,158 @@
+"""Tensor parallelism: parity, real shardings, and visible collectives.
+
+Strategy: the tp step is the ORDINARY jitted train/forward step — only the
+parameter placements change — so the tests check (1) tp=2 numerics match
+tp=1, (2) the parameters are genuinely distributed (per-device shard shapes
+shrink), (3) XLA actually inserted collectives into the compiled module.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from moolib_tpu.learner import (
+    ImpalaConfig,
+    impala_loss,
+    make_impala_train_step,
+    make_train_state,
+)
+from moolib_tpu.models import ImpalaNet, TransformerNet
+from moolib_tpu.models.transformer import segment_ids_from_done
+from moolib_tpu.parallel.mesh import make_mesh, shard_batch
+from moolib_tpu.parallel.tp import (
+    impala_tp_specs,
+    shard_params,
+    sharded_init_opt_state,
+    transformer_tp_specs,
+)
+
+
+def _transformer_setup():
+    net = TransformerNet(
+        num_actions=4, d_model=16, num_layers=1, num_heads=2,
+        attention_backend="dense",
+    )
+    T, B, F = 6, 4, 5
+    rng = np.random.default_rng(0)
+    obs = jnp.asarray(rng.standard_normal((T, B, F)), jnp.float32)
+    done = jnp.asarray(rng.random((T, B)) < 0.2)
+    params = net.init(jax.random.PRNGKey(0), obs, done, ())
+    return net, params, obs, done
+
+
+def test_transformer_tp_specs_cover_megatron_pattern():
+    net, params, _, _ = _transformer_setup()
+    specs = transformer_tp_specs(params)
+    flat = {
+        "/".join(getattr(k, "key", str(k)) for k in path): s
+        for path, s in jax.tree_util.tree_leaves_with_path(specs)
+    }
+    qkv = [k for k in flat if k.endswith("qkv/kernel")]
+    outs = [k for k in flat if k.endswith("out/kernel")]
+    ups = [k for k in flat if "Dense_0/kernel" in k and "block_" in k]
+    downs = [k for k in flat if "Dense_1/kernel" in k and "block_" in k]
+    assert qkv and outs and ups and downs
+    assert all(flat[k] == P(None, "tp") for k in qkv + ups)
+    assert all(flat[k] == P("tp", None) for k in outs + downs)
+    # Norms/embeddings replicate.
+    assert flat["params/pos_emb/embedding"] == P()
+
+
+def test_transformer_tp2_matches_tp1():
+    net, params, obs, done = _transformer_setup()
+
+    def fwd(params, obs, done):
+        (logits, baseline), _ = net.apply(params, obs, done, ())
+        return logits, baseline
+
+    ref_logits, ref_baseline = jax.jit(fwd)(params, obs, done)
+
+    mesh = make_mesh(dp=2, tp=2, sp=1, devices=jax.devices()[:4])
+    specs = transformer_tp_specs(params)
+    tp_params = shard_params(mesh, params, specs)
+    # Data dp-sharded on the batch axis, params tp-sharded: same jitted fn.
+    obs_s = jax.device_put(obs, NamedSharding(mesh, P(None, "dp", None)))
+    done_s = jax.device_put(done, NamedSharding(mesh, P(None, "dp")))
+    logits, baseline = jax.jit(fwd)(tp_params, obs_s, done_s)
+
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(baseline), np.asarray(ref_baseline), rtol=2e-5, atol=2e-5
+    )
+
+    # The qkv kernel must be genuinely distributed: each device holds half.
+    qkv = tp_params["params"]["block_0"]["attn"]["qkv"]["kernel"]
+    shard_shapes = {s.data.shape for s in qkv.addressable_shards}
+    assert shard_shapes == {(16, 24)}  # [d_model, 3*d_model/tp]
+
+
+def test_transformer_tp_train_step_collectives_and_parity():
+    """Full train step (loss+backward+adam) under dp=2 x tp=2: numerics match
+    the single-device step and the compiled HLO contains collectives."""
+    net, params, obs, done = _transformer_setup()
+    T, B = done.shape
+    A = 4
+    rng = np.random.default_rng(1)
+    batch = {
+        "obs": obs[: T],
+        "done": done,
+        "rewards": jnp.asarray(rng.standard_normal((T, B)), jnp.float32),
+        "actions": jnp.asarray(rng.integers(0, A, (T - 1, B)), jnp.int32),
+        "behavior_logits": jnp.zeros((T - 1, B, A), jnp.float32),
+        "core_state": (),
+    }
+    opt = optax.adam(1e-3)
+    step = make_impala_train_step(net.apply, opt, ImpalaConfig(), donate=False)
+
+    ref_state = make_train_state(params, opt)
+    ref_out, ref_metrics = step(ref_state, batch)
+
+    mesh = make_mesh(dp=2, tp=2, sp=1, devices=jax.devices()[:4])
+    specs = transformer_tp_specs(params)
+    tp_params = shard_params(mesh, params, specs)
+    tp_state = make_train_state(tp_params, opt)._replace(
+        opt_state=sharded_init_opt_state(opt, tp_params)
+    )
+    tp_batch = shard_batch(mesh, batch)
+    tp_out, tp_metrics = step(tp_state, tp_batch)
+
+    np.testing.assert_allclose(
+        float(tp_metrics["total_loss"]), float(ref_metrics["total_loss"]),
+        rtol=1e-4,
+    )
+    for (pa, a), (_pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(ref_out.params),
+        jax.tree_util.tree_leaves_with_path(tp_out.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=str(pa),
+        )
+
+    hlo = step.lower(tp_state, tp_batch).compile().as_text()
+    assert "all-reduce" in hlo or "reduce-scatter" in hlo, (
+        "no collectives in the compiled tp step"
+    )
+
+
+def test_impala_tp_specs_and_sharding():
+    net = ImpalaNet(num_actions=6)
+    obs = jnp.zeros((1, 1, 84, 84, 4), jnp.uint8)
+    done = jnp.zeros((1, 1), bool)
+    params = net.init(jax.random.PRNGKey(0), obs, done, ())
+    specs = impala_tp_specs(params)
+    mesh = make_mesh(dp=4, tp=2, sp=1, devices=jax.devices())
+    sharded = shard_params(mesh, params, specs)
+    hidden = sharded["params"]["Dense_0"]["kernel"]
+    # 3872 x 256 column-parallel: each device holds 256/2 output features.
+    assert {s.data.shape for s in hidden.addressable_shards} == {(3872, 128)}
+
+    (logits, baseline), _ = jax.jit(
+        lambda p, o, d: net.apply(p, o, d, ())
+    )(sharded, obs, done)
+    assert np.isfinite(np.asarray(logits)).all()

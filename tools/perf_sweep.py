@@ -1,0 +1,100 @@
+"""Perf sweep for the IMPALA learner bench: vary batch size / dtypes and
+report env-steps/s/chip + MFU for each config under the honest timing
+protocol from bench.py (chained in-jit steps, D2H scalar readback).
+
+Usage: python tools/perf_sweep.py [config ...]
+Configs are "B=512,dtype=bf16" style key=value strings; no args runs the
+default grid. One JSON line per config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run_config(B: int, dtype: str, iters: int = 10) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from moolib_tpu.learner import ImpalaConfig, make_impala_train_step, make_train_state
+    from moolib_tpu.models import ImpalaNet
+    from moolib_tpu.utils.flops import device_peak_flops, impala_train_flops
+
+    T, H, W, C, A = 20, 84, 84, 4, 6
+    cdt = {"bf16": jnp.bfloat16, "f32": jnp.float32}[dtype]
+    net = ImpalaNet(num_actions=A, use_lstm=False, compute_dtype=cdt)
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": jnp.asarray(rng.integers(0, 255, (T + 1, B, H, W, C), dtype=np.uint8)),
+        "done": jnp.asarray(rng.random((T + 1, B)) < 0.02),
+        "rewards": jnp.asarray(rng.standard_normal((T + 1, B)), jnp.float32),
+        "actions": jnp.asarray(rng.integers(0, A, (T, B)), jnp.int32),
+        "behavior_logits": jnp.zeros((T, B, A), jnp.float32),
+        "core_state": (),
+    }
+    params = net.init(jax.random.PRNGKey(0), batch["obs"][:, :1], batch["done"][:, :1], ())
+    opt = optax.chain(optax.clip_by_global_norm(40.0), optax.adam(6e-4))
+    state = make_train_state(params, opt)
+    step = make_impala_train_step(net.apply, opt, ImpalaConfig(), donate=True)
+
+    @jax.jit
+    def run_many(state, batch):
+        def body(_, s):
+            s, _m = step(s, batch)
+            return s
+
+        s = jax.lax.fori_loop(0, iters, body, state)
+        fp = sum(
+            jnp.sum(leaf.astype(jnp.float32))
+            for leaf in jax.tree_util.tree_leaves(s.params)
+        )
+        return s, fp
+
+    t_c0 = time.perf_counter()
+    state, fp = run_many(state, batch)
+    float(fp)
+    compile_s = time.perf_counter() - t_c0
+
+    t0 = time.perf_counter()
+    state, fp = run_many(state, batch)
+    assert np.isfinite(float(fp))
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = iters * T * B / dt
+    flops_step = impala_train_flops((T + 1) * B, num_actions=A)
+    achieved = flops_step * iters / dt
+    peak = device_peak_flops(jax.devices()[0].device_kind)
+    return {
+        "B": B,
+        "dtype": dtype,
+        "env_steps_per_sec": round(steps_per_sec, 1),
+        "tflops": round(achieved / 1e12, 2),
+        "mfu": round(achieved / peak, 4) if peak else None,
+        "compile_s": round(compile_s, 1),
+        "timed_s": round(dt, 3),
+    }
+
+
+def main():
+    grid = [(256, "bf16"), (512, "bf16"), (1024, "bf16"), (256, "f32")]
+    if len(sys.argv) > 1:
+        grid = []
+        for arg in sys.argv[1:]:
+            kv = dict(p.split("=") for p in arg.split(","))
+            grid.append((int(kv.get("B", 256)), kv.get("dtype", "bf16")))
+    for B, dtype in grid:
+        try:
+            print(json.dumps(run_config(B, dtype)), flush=True)
+        except Exception as e:  # keep sweeping past OOMs
+            print(json.dumps({"B": B, "dtype": dtype, "error": repr(e)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
