@@ -15,8 +15,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
 import sys
-import time
 
 NORTH_STAR_PER_CHIP = 1_000_000 / 32  # env-steps/sec/chip share
 
@@ -44,7 +44,9 @@ def main() -> None:
     # 84x84x4); B=256/chip saturates the MXU better than the per-peer 32
     # (measured 80k vs 45k env-steps/s/chip on one v5e with honest
     # readback timing).
-    T, B, H, W, C, A = 20, 256 * n_chips, 84, 84, 4, 6
+    # MOOLIB_BENCH_BATCH overrides per-chip B for smoke runs on slow backends.
+    per_chip_b = int(os.environ.get("MOOLIB_BENCH_BATCH", 256))
+    T, B, H, W, C, A = 20, per_chip_b * n_chips, 84, 84, 4, 6
     net = ImpalaNet(
         num_actions=A, use_lstm=False, compute_dtype=jnp.bfloat16
     )
@@ -77,36 +79,12 @@ def main() -> None:
         step = make_impala_train_step(
             net.apply, opt, ImpalaConfig(), donate=True
         )
-    # Honest timing protocol:
-    # (1) `iters` chained steps INSIDE one jit (lax.fori_loop) — per-dispatch
-    #     timing overstates throughput when the runtime pipelines dispatches;
-    # (2) the timed quantity ends in a host readback of a scalar fingerprint
-    #     of the updated parameters — on remote-device runtimes even
-    #     block_until_ready can return before device execution finishes
-    #     (measured 70x inflation through a device tunnel), but a
-    #     device-to-host value transfer cannot be faked.
+    # Honest timing protocol (chained in-jit steps + D2H fingerprint
+    # readback) — shared single source: moolib_tpu/utils/benchmark.py.
+    from moolib_tpu.utils.benchmark import time_train_step
+
     iters = 10
-
-    @jax.jit
-    def run_many(state, batch):
-        def body(_, s):
-            s, _metrics = step(s, batch)
-            return s
-
-        s = jax.lax.fori_loop(0, iters, body, state)
-        fingerprint = sum(
-            jnp.sum(leaf.astype(jnp.float32))
-            for leaf in jax.tree_util.tree_leaves(s.params)
-        )
-        return s, fingerprint
-
-    state, fp = run_many(state, batch)  # compile + warmup
-    float(fp)
-
-    t0 = time.perf_counter()
-    state, fp = run_many(state, batch)
-    assert np.isfinite(float(fp))  # D2H readback: forces real completion
-    dt = time.perf_counter() - t0
+    state, dt, _compile_s = time_train_step(step, state, batch, iters=iters)
 
     steps_per_sec = iters * T * B / dt
     per_chip = steps_per_sec / max(1, n_chips)

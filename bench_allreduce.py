@@ -177,5 +177,8 @@ def bench_ici_psum(sizes=(2**20, 2**23, 2**25)):
 
 
 if __name__ == "__main__":
+    from moolib_tpu.utils import ensure_platforms
+
+    ensure_platforms()  # honor JAX_PLATFORMS=cpu for the ICI leg
     bench_rpc_tree()
     bench_ici_psum()

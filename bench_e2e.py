@@ -1,0 +1,76 @@
+"""End-to-end benchmark: the FULL IMPALA loop — EnvPool acting, two-stage
+batching, H2D staging, jitted act + train steps, Accumulator-driven updates
+— on synthetic Atari-shaped pixels (no ALE dependency, deterministic env
+cost), measured as env-steps/s.
+
+This is the number the north-star metric actually names (BASELINE.md: env
+steps consumed end to end), next to bench.py's learner-only ceiling. The
+gap between the two is the host-side pipeline cost: env stepping, batching,
+H2D, and RPC control — everything the learner-only bench excludes.
+
+Prints ONE JSON line:
+  {"metric": "impala_e2e_env_steps_per_sec", "value", "unit",
+   "learner_only_gap_note"}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main(duration: float = 60.0) -> None:
+    from moolib_tpu.utils import ensure_platforms
+
+    ensure_platforms()
+
+    from moolib_tpu.examples.vtrace.experiment import VtraceConfig, train
+
+    rows = []
+    cfg = VtraceConfig(
+        env="synthetic",
+        actor_batch_size=64,
+        learn_batch_size=64,
+        virtual_batch_size=64,
+        num_actor_processes=4,
+        num_actor_batches=2,
+        unroll_length=20,
+        total_steps=10**9,  # bounded by max_seconds below
+        log_interval_steps=2_000,
+        stats_interval=2.0,
+        max_seconds=duration,
+    )
+    t0 = time.perf_counter()
+    rows = train(cfg, log_fn=lambda *_a, **_k: None)
+    elapsed = time.perf_counter() - t0
+    total_steps = rows[-1]["env_steps"] if rows else 0
+    # Skip the warmup window (compile + pool spin-up): measure from the
+    # first logged row to the last (rows carry a monotonic 'time' stamp).
+    if len(rows) >= 2:
+        steps = rows[-1]["env_steps"] - rows[0]["env_steps"]
+        span = rows[-1]["time"] - rows[0]["time"]
+        sps = steps / max(span, 1e-9)
+    else:
+        sps = total_steps / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "impala_e2e_env_steps_per_sec",
+                "value": round(sps, 1),
+                "unit": "env-steps/s (1 peer, acting+batching+H2D+train)",
+                "total_env_steps": int(total_steps),
+                "wall_s": round(elapsed, 1),
+                "learner_only_gap_note": (
+                    "bench.py measures the resident-batch train step alone; "
+                    "the difference to this number is host pipeline cost "
+                    "(env stepping, batching, H2D, RPC control)"
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    dur = float(sys.argv[1]) if len(sys.argv) > 1 else 60.0
+    sys.exit(main(dur))

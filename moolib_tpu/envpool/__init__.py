@@ -1,8 +1,18 @@
 from .pool import EnvPool, EnvStepper, EnvStepperFuture
+from .stepper import EnvPoolServer, RemoteEnvStepper
 
 # Import-parity alias (reference exports EnvRunner, py/moolib/__init__.py:2-45).
 # In this design the worker loop lives inside the pool's spawned processes;
-# the pool object is the user-facing handle for both roles.
+# the pool object is the user-facing handle for both roles. Multi-client
+# serving (the reference's EnvStepper-over-spawn topology, src/env.cc:176-249)
+# is EnvPoolServer + RemoteEnvStepper over the RPC plane.
 EnvRunner = EnvPool
 
-__all__ = ["EnvPool", "EnvRunner", "EnvStepper", "EnvStepperFuture"]
+__all__ = [
+    "EnvPool",
+    "EnvPoolServer",
+    "EnvRunner",
+    "EnvStepper",
+    "EnvStepperFuture",
+    "RemoteEnvStepper",
+]

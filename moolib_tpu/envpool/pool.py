@@ -488,6 +488,12 @@ class EnvPool:
                 conn.send(("step", batch_index))
         return EnvStepperFuture(self, batch_index, self._events[batch_index])
 
+    def busy(self, batch_index: int) -> bool:
+        """Whether a step on this buffer is still in flight (result not yet
+        collected)."""
+        with self._lock:
+            return bool(self._busy[batch_index])
+
     def _push_cmd(self, w: int, cmd: int):
         slots, tail = self._rings[w]
         head = self._ring_heads[w]

@@ -1,0 +1,167 @@
+"""Multi-client env serving: one EnvPool, many stepper clients over RPC.
+
+Capability parity with the reference's EnvStepper topology (reference:
+src/env.cc:176-249 and src/env.h:46 — one forked env server serves up to 256
+independent stepper clients, each driving its own batched buffer), redesigned
+for this framework's layering: the pool's shared-memory data plane stays
+process-local to the serving peer, and clients — local or remote actors —
+drive it through the named-peer RPC layer, which already does zero-copy
+tensor framing. An actor peer on another host steps envs on the env host
+with exactly the same calls as a local client.
+
+Usage::
+
+    # env-server peer
+    pool = EnvPool(create_env, num_processes=4, batch_size=32, num_batches=4)
+    server = EnvPoolServer(rpc, pool)           # defines envpool::* functions
+
+    # any peer (same or different process/host)
+    stepper = RemoteEnvStepper(rpc, "env-server")   # acquires a buffer
+    fut = stepper.step(actions)                     # -> Future of step dict
+    out = fut.result()                              # obs/reward/done/stats
+
+Each client owns one of the pool's ``num_batches`` buffers, so clients
+double-buffer *against each other*: while client A's batch steps in the
+workers, client B's batch is in flight too (the reference gets the same
+overlap from its bufferBusy rotation, src/env.cc:273-349).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..utils import get_logger
+
+log = get_logger("envstepper")
+
+__all__ = ["EnvPoolServer", "RemoteEnvStepper"]
+
+
+class EnvPoolServer:
+    """Serve an :class:`EnvPool` to N stepper clients over an ``Rpc`` peer.
+
+    Defines (under ``name::``):
+      - ``info()`` -> {batch_size, num_batches, action_dtype}
+      - ``acquire(client)`` -> dedicated batch index for that client
+      - ``release(batch_index)`` -> return a buffer to the free list
+      - ``step(batch_index, action)`` -> step-result dict (blocks the
+        serving thread until the workers finish — callers overlap by using
+        distinct buffers, so ``num_batches`` steps proceed concurrently)
+    """
+
+    def __init__(self, rpc, pool, name: str = "envpool"):
+        self.rpc = rpc
+        self.pool = pool
+        self.name = name
+        self._lock = threading.Lock()
+        self._free = list(range(pool.num_batches))
+        self._owners: dict = {}
+        rpc.define(f"{name}::info", self._info)
+        rpc.define(f"{name}::acquire", self._acquire)
+        rpc.define(f"{name}::release", self._release)
+        rpc.define(f"{name}::step", self._step)
+
+    def _info(self):
+        return {
+            "batch_size": self.pool.batch_size,
+            "num_batches": self.pool.num_batches,
+        }
+
+    def _acquire(self, client: str):
+        with self._lock:
+            if not self._free:
+                raise RuntimeError(
+                    f"all {self.pool.num_batches} env buffers are taken; "
+                    "raise num_batches to serve more concurrent clients"
+                )
+            idx = self._free.pop(0)
+            self._owners[idx] = client
+            log.info("env buffer %d -> client %s", idx, client)
+            return idx
+
+    def _release(self, batch_index: int):
+        with self._lock:
+            if self._owners.pop(batch_index, None) is None:
+                return False
+        if self.pool.busy(batch_index):
+            # The closing client still has a step executing (its ::step
+            # handler is blocked in the pool); freeing the buffer now would
+            # hand the next client a busy buffer. Defer until it drains.
+            threading.Thread(
+                target=self._free_when_idle, args=(batch_index,), daemon=True
+            ).start()
+        else:
+            with self._lock:
+                self._free.append(batch_index)
+        return True
+
+    def _free_when_idle(self, batch_index: int, timeout: float = 120.0):
+        deadline = time.monotonic() + timeout
+        while self.pool.busy(batch_index) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with self._lock:
+            if not self.pool.busy(batch_index):
+                self._free.append(batch_index)
+            else:
+                log.warning(
+                    "env buffer %d stuck busy after release; leaked",
+                    batch_index,
+                )
+
+    def _step(self, batch_index: int, action):
+        # Runs on the rpc executor; blocking here is the backpressure the
+        # client's Future surfaces. Distinct buffers run concurrently.
+        return self.pool.step(batch_index, np.asarray(action)).result()
+
+    def close(self):
+        for fn in ("info", "acquire", "release", "step"):
+            try:
+                self.rpc.undefine(f"{self.name}::{fn}")
+            except Exception:
+                pass
+
+
+class RemoteEnvStepper:
+    """Client handle: step a (possibly remote) peer's EnvPool.
+
+    Acquires a dedicated buffer on construction; ``step`` is asynchronous,
+    so N clients (threads, processes, or hosts) overlap their batches in
+    the one pool's workers.
+    """
+
+    def __init__(self, rpc, server: str, name: str = "envpool",
+                 timeout: float = 60.0):
+        self.rpc = rpc
+        self.server = server
+        self.name = name
+        info = rpc.async_(server, f"{name}::info").result(timeout)
+        self.batch_size = info["batch_size"]
+        self.num_batches = info["num_batches"]
+        self.batch_index = rpc.async_(
+            server, f"{name}::acquire", rpc.get_name()
+        ).result(timeout)
+        self._closed = False
+
+    def step(self, action):
+        """Async batched step on this client's buffer -> Future of the
+        step-result dict (obs fields, reward, done, episode stats)."""
+        if self._closed:
+            raise RuntimeError("RemoteEnvStepper is closed")
+        return self.rpc.async_(
+            self.server, f"{self.name}::step", self.batch_index,
+            np.asarray(action),
+        )
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            try:
+                self.rpc.async_(
+                    self.server, f"{self.name}::release", self.batch_index
+                ).result(10.0)
+            except Exception:
+                pass  # server gone: buffer dies with it

@@ -74,6 +74,7 @@ class VtraceConfig:
     use_lstm: bool = False
     model: str = "auto"  # auto | mlp | resnet | transformer
     total_steps: int = 500_000
+    max_seconds: Optional[float] = None  # wall-clock stop (benchmarks)
     # infra
     broker: Optional[str] = None  # None -> in-process broker
     group: str = "vtrace"
@@ -283,11 +284,15 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
     env_steps = 0
     next_log = cfg.log_interval_steps
     last_stats_enqueue = 0.0
-    last_sps_mark = (time.monotonic(), 0)
+    t_start = time.monotonic()
+    last_sps_mark = (t_start, 0)
     futures = [pool.step(i, actions[i]) for i in range(cfg.num_actor_batches)]
 
     try:
-        while env_steps < cfg.total_steps:
+        while env_steps < cfg.total_steps and (
+            cfg.max_seconds is None
+            or time.monotonic() - t_start < cfg.max_seconds
+        ):
             # -- acting (double-buffered) -----------------------------------
             for i in range(cfg.num_actor_batches):
                 out = futures[i].result()
@@ -378,6 +383,7 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                 g = gsa.global_stats.results()
                 row = dict(
                     window.results(),
+                    time=now,
                     env_steps=env_steps,
                     global_env_steps=g.get("env_steps", 0.0),
                     global_return=g.get("episode_returns", float("nan")),

@@ -401,39 +401,47 @@ def flash_attention(
     )
 
 
-_flash_probe_ok: Optional[bool] = None
+_flash_probe_cache: dict = {}
 
 
-def _probe_flash() -> bool:
-    """One-time check that the pallas kernel actually compiles on this TPU.
+def _probe_flash(block_q: int, block_k: int) -> bool:
+    """Check (once per block shape) that the pallas kernel compiles on this
+    TPU with the blocks 'auto' is about to dispatch.
 
     'auto' must never hard-fail on first hardware contact: Mosaic can reject
     a kernel shape (e.g. the (block_q,)-VMEM scratch) at compile time on a
-    backend generation the kernel was never tried on. Probing with a tiny
-    shape at Python level (outside any surrounding jit trace) lets 'auto'
-    degrade to blockwise instead of poisoning the caller's compile.
+    backend generation the kernel was never tried on — and the failure class
+    is block-shape-dependent, so the probe must use the caller's effective
+    block sizes, memoized per (block_q, block_k). Probing at Python level
+    (outside any surrounding jit trace) lets 'auto' degrade to blockwise
+    instead of poisoning the caller's compile.
     """
-    global _flash_probe_ok
-    if _flash_probe_ok is None:
+    key = (block_q, block_k)
+    ok = _flash_probe_cache.get(key)
+    if ok is None:
         try:
-            # Probe with the dispatcher's DEFAULT block sizes (256x256) and a
-            # multi-block grid — a probe at a different block shape could
-            # pass while the real call still fails, since the failure class
-            # being screened (Mosaic scratch-shape rejection) is
-            # block-shape-dependent. Both causal branches compile.
-            q = jnp.zeros((1, 1, 512, 64), jnp.float32)
-            jax.block_until_ready(flash_attention(q, q, q))
-            jax.block_until_ready(flash_attention(q, q, q, causal=True))
-            _flash_probe_ok = True
+            # Multi-block grid in both q and k; both causal branches.
+            q = jnp.zeros((1, 1, 2 * block_q, 64), jnp.float32)
+            kv = jnp.zeros((1, 1, 2 * block_k, 64), jnp.float32)
+            jax.block_until_ready(
+                flash_attention(q, kv, kv, block_q=block_q, block_k=block_k)
+            )
+            jax.block_until_ready(
+                flash_attention(
+                    q, kv, kv, causal=True, block_q=block_q, block_k=block_k
+                )
+            )
+            ok = True
         except Exception as e:  # Mosaic lowering/compile rejection
             import logging
 
             logging.getLogger("moolib_tpu.attention").warning(
-                "pallas flash attention unavailable on this backend (%s); "
-                "'auto' will use blockwise", e
+                "pallas flash attention unavailable for blocks %s on this "
+                "backend (%s); 'auto' will use blockwise", key, e
             )
-            _flash_probe_ok = False
-    return _flash_probe_ok
+            ok = False
+        _flash_probe_cache[key] = ok
+    return ok
 
 
 def attention(q, k, v, backend: str = "auto", **kw):
@@ -447,7 +455,7 @@ def attention(q, k, v, backend: str = "auto", **kw):
             jax.default_backend() == "tpu"
             and Tq % bq == 0
             and Tk % bk == 0
-            and _probe_flash()
+            and _probe_flash(bq, bk)
         ):
             backend = "flash"
         elif Tq * Tk <= 1024 * 1024:

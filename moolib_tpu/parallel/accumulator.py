@@ -419,14 +419,24 @@ class Accumulator:
             ]
             if not members:
                 return
-            # State and its version label must be read atomically (same rule
-            # as _serve_state): a result applied between the two reads would
-            # mislabel the broadcast one version low.
             version = self._model_version - len(self._results)
-            payload = {
-                "state": _to_numpy_tree(self._get_state()),
-                "model_version": version,
-            }
+            cursor = self._release_gseq
+        # get_state (a full-model D2H in real use) must NOT run under the
+        # lock — it would stall every RPC-thread round callback. Instead
+        # verify after the fact that no result was released (cursor) or
+        # applied (version formula) while we were copying; if one was, the
+        # (state, version) pair may be torn, so skip this tick and let the
+        # next interval broadcast.
+        payload = {
+            "state": _to_numpy_tree(self._get_state()),
+            "model_version": version,
+        }
+        with self._lock:
+            if (
+                self._model_version - len(self._results) != version
+                or self._release_gseq != cursor
+            ):
+                return
         for m in members:
             self.rpc.async_callback(
                 m, "AccumulatorService::pushState",
@@ -442,10 +452,16 @@ class Accumulator:
             version = int(payload["model_version"])
             if self.is_leader() or self._applying_push:
                 return False
-            # Only apply when nothing released-but-unapplied is queued
-            # locally: those updates are already inside a newer leader state,
-            # and applying both would double-count them.
-            if self._results or version < self._model_version:
+            # Only apply when nothing is queued, parked, OR still reducing
+            # locally: a round whose update is already inside the pushed
+            # leader state could otherwise settle after the push and be
+            # applied a second time by the training thread.
+            if (
+                self._results
+                or self._grad_outcomes
+                or self._grads_inflight
+                or version < self._model_version
+            ):
                 return False
             # Freeze result release for the duration of the (slow, outside
             # the lock) apply: a result released + applied by the training

@@ -108,3 +108,49 @@ def test_peer_death_resync(tmp_path):
                 p.wait(timeout=10)
         broker.terminate()
         broker.wait(timeout=10)
+
+
+@pytest.mark.integration
+def test_peer_join_midstream(tmp_path):
+    """A second peer joins a running training cluster as a real OS process:
+    it must sync the leader's state, contribute updates, and both peers keep
+    advancing (complements the SIGKILL test; the in-process variant lives in
+    test_accumulator.py — this one crosses real serialization/process
+    boundaries)."""
+    broker = subprocess.Popen(
+        [sys.executable, "-m", "moolib_tpu.broker", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    procs = []
+    try:
+        addr = None
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            line = broker.stdout.readline()
+            if "listening on" in line:
+                addr = line.rsplit(" ", 1)[-1].strip()
+                break
+        assert addr, "broker never reported its address"
+
+        d0, d1 = str(tmp_path / "p0"), str(tmp_path / "p1")
+        p0 = _peer(addr, d0)
+        procs = [p0]
+
+        # Peer0 trains alone for a while.
+        _wait_progress(d0, 10, 120, "peer0 solo")
+
+        # Peer1 joins midstream: epoch reset, election, state catch-up.
+        p1 = _peer(addr, d1)
+        procs.append(p1)
+        before = _rows(d0)[-1]["updates"]
+        _wait_progress(d1, 5, 120, "peer1 after joining")
+        _wait_progress(d0, before + 10, 120, "peer0 after peer1 joined")
+
+        assert p0.poll() is None and p1.poll() is None
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+                p.wait(timeout=10)
+        broker.terminate()
+        broker.wait(timeout=10)

@@ -12,7 +12,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -44,28 +43,9 @@ def run_config(B: int, dtype: str, iters: int = 10) -> dict:
     state = make_train_state(params, opt)
     step = make_impala_train_step(net.apply, opt, ImpalaConfig(), donate=True)
 
-    @jax.jit
-    def run_many(state, batch):
-        def body(_, s):
-            s, _m = step(s, batch)
-            return s
+    from moolib_tpu.utils.benchmark import time_train_step
 
-        s = jax.lax.fori_loop(0, iters, body, state)
-        fp = sum(
-            jnp.sum(leaf.astype(jnp.float32))
-            for leaf in jax.tree_util.tree_leaves(s.params)
-        )
-        return s, fp
-
-    t_c0 = time.perf_counter()
-    state, fp = run_many(state, batch)
-    float(fp)
-    compile_s = time.perf_counter() - t_c0
-
-    t0 = time.perf_counter()
-    state, fp = run_many(state, batch)
-    assert np.isfinite(float(fp))
-    dt = time.perf_counter() - t0
+    state, dt, compile_s = time_train_step(step, state, batch, iters=iters)
 
     steps_per_sec = iters * T * B / dt
     flops_step = impala_train_flops((T + 1) * B, num_actions=A)
