@@ -45,34 +45,48 @@ class EnvPoolServer:
     """Serve an :class:`EnvPool` to N stepper clients over an ``Rpc`` peer.
 
     Defines (under ``name::``):
-      - ``info()`` -> {batch_size, num_batches, action_dtype}
+      - ``info()`` -> {batch_size, num_batches, action_shape, action_dtype}
       - ``acquire(client)`` -> dedicated batch index for that client
       - ``release(batch_index)`` -> return a buffer to the free list
-      - ``step(batch_index, action)`` -> step-result dict (blocks the
-        serving thread until the workers finish — callers overlap by using
-        distinct buffers, so ``num_batches`` steps proceed concurrently)
+      - ``step(batch_index, action, client)`` -> step-result dict (blocks
+        the serving thread until the workers finish — callers overlap by
+        using distinct buffers, so ``num_batches`` steps proceed
+        concurrently)
+
+    A dead client's buffer is reclaimed by lease expiry: a buffer whose
+    owner hasn't stepped for ``lease_timeout`` seconds may be handed to a
+    new client on acquire (an actor SIGKILL must not remove env capacity
+    forever — elasticity is the framework's flagship property).
     """
 
-    def __init__(self, rpc, pool, name: str = "envpool"):
+    def __init__(self, rpc, pool, name: str = "envpool",
+                 lease_timeout: float = 60.0):
         self.rpc = rpc
         self.pool = pool
         self.name = name
+        self.lease_timeout = lease_timeout
         self._lock = threading.Lock()
         self._free = list(range(pool.num_batches))
         self._owners: dict = {}
+        self._last_step: dict = {}
         rpc.define(f"{name}::info", self._info)
         rpc.define(f"{name}::acquire", self._acquire)
         rpc.define(f"{name}::release", self._release)
         rpc.define(f"{name}::step", self._step)
 
     def _info(self):
+        action = self.pool._views[0]["action"]
         return {
             "batch_size": self.pool.batch_size,
             "num_batches": self.pool.num_batches,
+            "action_shape": tuple(action.shape[1:]),
+            "action_dtype": str(action.dtype),
         }
 
     def _acquire(self, client: str):
         with self._lock:
+            if not self._free:
+                self._reclaim_expired_locked()
             if not self._free:
                 raise RuntimeError(
                     f"all {self.pool.num_batches} env buffers are taken; "
@@ -80,8 +94,23 @@ class EnvPoolServer:
                 )
             idx = self._free.pop(0)
             self._owners[idx] = client
+            self._last_step[idx] = time.monotonic()
             log.info("env buffer %d -> client %s", idx, client)
             return idx
+
+    def _reclaim_expired_locked(self):
+        now = time.monotonic()
+        for idx, owner in list(self._owners.items()):
+            if (
+                now - self._last_step.get(idx, now) > self.lease_timeout
+                and not self.pool.busy(idx)
+            ):
+                log.warning(
+                    "reclaiming env buffer %d from silent client %s",
+                    idx, owner,
+                )
+                del self._owners[idx]
+                self._free.append(idx)
 
     def _release(self, batch_index: int):
         with self._lock:
@@ -112,7 +141,17 @@ class EnvPoolServer:
                     batch_index,
                 )
 
-    def _step(self, batch_index: int, action):
+    def _step(self, batch_index: int, action, client: Optional[str] = None):
+        # Ownership check: a stale step racing a release/re-acquire must
+        # never touch a buffer that now belongs to someone else.
+        with self._lock:
+            owner = self._owners.get(batch_index)
+            if client is not None and owner != client:
+                raise RuntimeError(
+                    f"env buffer {batch_index} is not owned by {client!r} "
+                    f"(owner: {owner!r}); re-acquire before stepping"
+                )
+            self._last_step[batch_index] = time.monotonic()
         # Runs on the rpc executor; blocking here is the backpressure the
         # client's Future surfaces. Distinct buffers run concurrently.
         return self.pool.step(batch_index, np.asarray(action)).result()
@@ -153,7 +192,7 @@ class RemoteEnvStepper:
             raise RuntimeError("RemoteEnvStepper is closed")
         return self.rpc.async_(
             self.server, f"{self.name}::step", self.batch_index,
-            np.asarray(action),
+            np.asarray(action), self.rpc.get_name(),
         )
 
     def close(self):

@@ -32,7 +32,21 @@ __all__ = [
     "StatMax",
     "Stats",
     "nest",
+    "obs_from_env_out",
 ]
+
+_ENV_OUT_RESERVED = ("action", "reward", "done", "episode_step",
+                     "episode_return")
+
+
+def obs_from_env_out(env_out):
+    """Extract the observation from an EnvPool step dict: a bare array when
+    the env observes a single array (key 'obs'), else the dict of obs
+    fields (NLE-style dict observations)."""
+    obs_keys = [k for k in env_out if k not in _ENV_OUT_RESERVED]
+    if obs_keys == ["obs"]:
+        return env_out["obs"]
+    return {k: env_out[k] for k in obs_keys}
 
 
 class InProcessBroker:
@@ -105,17 +119,7 @@ class EnvBatchState:
                 del self._completed_returns[:-1_000]
             if len(self._completed_lengths) > 10_000:
                 del self._completed_lengths[:-1_000]
-        obs_keys = [
-            k
-            for k in env_out
-            if k
-            not in ("action", "reward", "done", "episode_step", "episode_return")
-        ]
-        obs = (
-            env_out[obs_keys[0]]
-            if obs_keys == ["obs"]
-            else {k: env_out[k] for k in obs_keys}
-        )
+        obs = obs_from_env_out(env_out)
         # Copy: EnvPool returns zero-copy views over shared memory that the
         # next step into this buffer will overwrite.
         frame = {

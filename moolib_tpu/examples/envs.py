@@ -30,9 +30,13 @@ import numpy as np
 __all__ = [
     "CartPole",
     "SyntheticAtari",
+    "SyntheticNetHack",
+    "SyntheticProcgen",
     "create_cartpole",
     "create_synthetic_atari",
     "create_atari",
+    "create_nethack",
+    "create_procgen",
 ]
 
 
@@ -162,6 +166,126 @@ class SyntheticAtari:
         terminated = False
         truncated = self._steps >= self.episode_length
         return self._obs(), reward, terminated, truncated, {}
+
+
+class SyntheticProcgen(SyntheticAtari):
+    """ProcGen-shaped pixel env: 64x64x3 uint8, 15 discrete actions
+    (driver benchmark config 4: IMPALA on ProcGen with ResNet encoder —
+    same learnable-cue protocol as :class:`SyntheticAtari` so the pipeline
+    can be exercised and benchmarked without the procgen package)."""
+
+    def __init__(self, num_actions: int = 15, episode_length: int = 500,
+                 seed: Optional[int] = None):
+        super().__init__(
+            num_actions=num_actions, channels=3, size=64,
+            episode_length=episode_length, seed=seed,
+        )
+
+    def _obs(self) -> np.ndarray:
+        frame = self._noise[self._steps % len(self._noise)].copy()
+        # 15 actions x 4-wide cue bands fit the 64-px row.
+        frame[:8, :, :] = 0
+        c0 = self._cue * 4
+        frame[:8, c0 : c0 + 4, :] = 255
+        return frame
+
+
+class SyntheticNetHack:
+    """NetHack-shaped dict-observation env (driver benchmark config 5:
+    R2D2-style LSTM policy on NLE — recurrent rollout batching).
+
+    Observation dict mirrors NLE's core keys: ``glyphs`` [21, 79] int16 and
+    ``blstats`` [27] float32. A cue glyph row encodes which action yields
+    reward this step, so an LSTM policy has a learnable signal without the
+    nle package installed.
+    """
+
+    DUNGEON_SHAPE = (21, 79)
+    BLSTATS_SIZE = 27
+    NUM_GLYPHS = 5976  # nle.nethack.MAX_GLYPH
+
+    def __init__(self, num_actions: int = 23, episode_length: int = 400,
+                 seed: Optional[int] = None):
+        self.num_actions = num_actions
+        self.episode_length = episode_length
+        self._rng = np.random.default_rng(seed)
+        self._glyph_bank = self._rng.integers(
+            0, self.NUM_GLYPHS, size=(8,) + self.DUNGEON_SHAPE, dtype=np.int16
+        )
+        self._cue = 0
+        self._steps = 0
+
+    def _obs(self):
+        glyphs = self._glyph_bank[self._steps % 8].copy()
+        glyphs[0, :] = 0
+        glyphs[0, self._cue * 3 : self._cue * 3 + 3] = 42  # cue glyphs
+        blstats = np.zeros(self.BLSTATS_SIZE, np.float32)
+        blstats[0] = self._steps
+        blstats[1] = self._cue
+        return {"glyphs": glyphs, "blstats": blstats}
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._steps = 0
+        self._cue = int(self._rng.integers(self.num_actions))
+        return self._obs(), {}
+
+    def step(self, action):
+        reward = 1.0 if int(action) == self._cue else 0.0
+        self._steps += 1
+        self._cue = int(self._rng.integers(self.num_actions))
+        return (
+            self._obs(), reward, False,
+            self._steps >= self.episode_length, {},
+        )
+
+
+def create_procgen(env_name: str = "coinrun", index: int = 0,
+                   num_actions: int = 15):
+    """ProcGen factory: the real gym3 env when procgen is installed, else
+    the synthetic ProcGen-shaped stand-in (same contract)."""
+    try:
+        import gym
+
+        env = gym.make(
+            f"procgen:procgen-{env_name}-v0", start_level=index,
+            num_levels=0, distribution_mode="easy",
+        )
+
+        class _Gym21(  # procgen ships the old gym API; adapt to gymnasium's
+            object
+        ):
+            num_actions = env.action_space.n
+
+            def reset(self, seed=None):
+                return env.reset(), {}
+
+            def step(self, action):
+                # No internal auto-reset: the EnvPool worker owns the reset
+                # on done (doubling it would burn a level generation and
+                # skip an episode per boundary).
+                obs, reward, done, info = env.step(int(action))
+                return obs, float(reward), bool(done), False, info
+
+        return _Gym21()
+    except Exception:
+        return SyntheticProcgen(num_actions=num_actions, seed=index)
+
+
+def create_nethack(index: int = 0, num_actions: int = 23):
+    """NetHack factory: the real NLE env when nle is installed, else the
+    synthetic NetHack-shaped stand-in (same dict-obs contract)."""
+    try:
+        import gymnasium
+        import nle  # noqa: F401
+
+        env = gymnasium.make("NetHackScore-v0",
+                             observation_keys=("glyphs", "blstats"))
+        env.reset(seed=index)
+        return env
+    except Exception:
+        return SyntheticNetHack(num_actions=num_actions, seed=index)
 
 
 def create_cartpole(index: int = 0, prefer_gymnasium: bool = True):

@@ -36,6 +36,7 @@ import numpy as np
 
 import moolib_tpu
 from moolib_tpu.examples.common import EnvBatchState, StatMean, StatSum, Stats
+from moolib_tpu.examples import common
 from moolib_tpu.examples.common.record import TsvLogger, write_metadata
 from moolib_tpu.examples import envs as env_factories
 
@@ -79,6 +80,8 @@ class VtraceConfig:
     broker: Optional[str] = None  # None -> in-process broker
     group: str = "vtrace"
     savedir: Optional[str] = None
+    wandb: bool = False  # log rows to wandb when the package is available
+    wandb_project: str = "moolib_tpu"
     checkpoint_interval: float = 600.0
     checkpoint_history_interval: Optional[float] = 3600.0
     log_interval_steps: int = 10_000
@@ -96,13 +99,29 @@ def _make_env_fn(cfg: VtraceConfig):
             num_actions=cfg.num_actions,
             episode_length=cfg.episode_length,
         )
+    if cfg.env == "nethack":  # benchmark config 5 (real NLE when installed)
+        return functools.partial(
+            env_factories.create_nethack, num_actions=cfg.num_actions
+        )
+    if cfg.env == "procgen" or cfg.env.startswith("procgen:"):
+        # benchmark config 4 (real procgen when installed)
+        name = cfg.env.split(":", 1)[1] if ":" in cfg.env else "coinrun"
+        return functools.partial(
+            env_factories.create_procgen, name,
+            num_actions=cfg.num_actions,
+        )
     return functools.partial(env_factories.create_atari, cfg.env)
 
 
 def _make_model(cfg: VtraceConfig):
     import jax.numpy as jnp
 
-    from moolib_tpu.models import A2CNet, ImpalaNet, TransformerNet
+    from moolib_tpu.models import (
+        A2CNet,
+        ImpalaNet,
+        NetHackNet,
+        TransformerNet,
+    )
 
     num_actions = 2 if cfg.env == "cartpole" else cfg.num_actions
     dtype = (
@@ -110,11 +129,21 @@ def _make_model(cfg: VtraceConfig):
     )
     model = cfg.model
     if model == "auto":
-        model = "mlp" if cfg.env == "cartpole" else "resnet"
+        if cfg.env == "cartpole":
+            model = "mlp"
+        elif cfg.env == "nethack":
+            model = "nethack"
+        else:
+            model = "resnet"
     if model == "mlp":
         return A2CNet(num_actions=num_actions, use_lstm=cfg.use_lstm)
     if model == "transformer":
         return TransformerNet(num_actions=num_actions, compute_dtype=dtype)
+    if model == "nethack":
+        return NetHackNet(
+            num_actions=num_actions, use_lstm=cfg.use_lstm,
+            compute_dtype=dtype,
+        )
     if model == "resnet":
         return ImpalaNet(
             num_actions=num_actions,
@@ -170,6 +199,19 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
     rng, init_rng = jax.random.split(rng)
     if cfg.env == "cartpole":
         dummy_obs = jnp.zeros((1, 1, 4), jnp.float32)
+    elif cfg.env == "nethack":
+        from moolib_tpu.examples.envs import SyntheticNetHack
+
+        dummy_obs = {
+            "glyphs": jnp.zeros(
+                (1, 1) + SyntheticNetHack.DUNGEON_SHAPE, jnp.int16
+            ),
+            "blstats": jnp.zeros(
+                (1, 1, SyntheticNetHack.BLSTATS_SIZE), jnp.float32
+            ),
+        }
+    elif cfg.env == "procgen" or cfg.env.startswith("procgen:"):
+        dummy_obs = jnp.zeros((1, 1, 64, 64, 3), jnp.uint8)
     else:
         dummy_obs = jnp.zeros((1, 1, 84, 84, 4), jnp.uint8)
     params = net.init(
@@ -252,6 +294,20 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
     tsv = (
         TsvLogger(os.path.join(cfg.savedir, "logs.tsv")) if cfg.savedir else None
     )
+    wandb_run = None
+    if cfg.wandb:
+        # Optional, like the reference's wandb hookup (reference:
+        # examples/vtrace/experiment.py:269-276); absence degrades to tsv.
+        try:
+            import wandb
+
+            wandb_run = wandb.init(
+                project=cfg.wandb_project,
+                name=rpc.get_name(),
+                config=dataclasses.asdict(cfg),
+            )
+        except Exception as e:
+            log_fn(f"wandb disabled ({e}); logging to tsv only")
     logs: List[dict] = []
 
     # --- env pool ----------------------------------------------------------
@@ -310,10 +366,13 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                     else:
                         stats["dropped_unrolls"] += 1
                 rng, act_rng = jax.random.split(rng)
+                obs_now = jax.tree_util.tree_map(
+                    jnp.asarray, common.obs_from_env_out(out)
+                )
                 a, logits, core = act(
                     state.params,
                     act_rng,
-                    jnp.asarray(out["obs"]),
+                    obs_now,
                     jnp.asarray(out["done"]),
                     bs.core_state,
                 )
@@ -333,8 +392,10 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                 if accumulator.wants_gradients():
                     if not learn_batcher.empty():
                         batch = learn_batcher.get()
+                        # Per-leaf staging: obs may be a dict (NLE-style)
+                        # and core_state a tuple of [B, ...] leaves.
                         batch = {
-                            k: (v if isinstance(v, tuple) else jnp.asarray(v))
+                            k: jax.tree_util.tree_map(jnp.asarray, v)
                             for k, v in batch.items()
                         }
                         if mesh is not None:
@@ -395,6 +456,8 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                 logs.append(row)
                 if tsv is not None:
                     tsv.log(row)
+                if wandb_run is not None:
+                    wandb_run.log(row, step=env_steps)
                 log_fn(
                     "steps {env_steps:>9}  return {episode_returns:8.2f}  "
                     "global {global_return:8.2f}  loss {total_loss:8.4f}  "
@@ -408,6 +471,8 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
         rpc.close()
         if broker is not None:
             broker.close()
+        if wandb_run is not None:
+            wandb_run.finish()
     return logs
 
 

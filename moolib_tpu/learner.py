@@ -302,8 +302,11 @@ def make_act_step(apply_fn: Callable, temperature: float = 1.0):
 
     @jax.jit
     def act(params, rng, obs, done, core_state):
+        # obs may be a bare array or a dict of arrays (NLE-style); add the
+        # T=1 axis per leaf either way.
+        obs_t = jax.tree_util.tree_map(lambda x: x[None], obs)
         (logits, _), core_state = apply_fn(
-            params, obs[None], done[None], core_state
+            params, obs_t, done[None], core_state
         )
         # Return the temperature-scaled logits: they must describe the
         # distribution the action was actually sampled from, since callers

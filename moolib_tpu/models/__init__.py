@@ -1,6 +1,7 @@
 from .a2c import A2CNet
 from .core import LSTMCore
 from .impala import ConvSequence, ImpalaNet, ResidualBlock
+from .nethack import NetHackNet
 from .transformer import TransformerNet
 
 __all__ = [
@@ -8,6 +9,7 @@ __all__ = [
     "LSTMCore",
     "ConvSequence",
     "ImpalaNet",
+    "NetHackNet",
     "ResidualBlock",
     "TransformerNet",
 ]

@@ -37,8 +37,10 @@ import atexit
 import concurrent.futures
 import hashlib
 import itertools
+import math
 import os
 import pickle
+import random as _pyrandom
 import secrets
 import socket as pysocket
 import threading
@@ -1324,11 +1326,35 @@ def _batched_server_loop(queue: Queue, fn: Callable, device,
             return_cb.error(f"{type(e).__name__}: {e}")
 
 
+# Fraction of sends routed by softmax sampling instead of pure argmin, so a
+# transport that measured slow once (and then idled) keeps getting occasional
+# traffic to refresh its latency EWMA (reference: the softmax transport
+# bandit, src/rpc.cc:640-716; pure argmin never re-explores).
+_BANDIT_EXPLORE = 0.05
+_bandit_rng = _pyrandom.Random(0x6D6F6F)
+
+
 def _best_conn(peer: _Peer) -> Optional[_Conn]:
-    """Lowest-EWMA-latency live connection; unix wins ties (the two-transport
-    degenerate case of the reference's bandit, src/rpc.cc:640-716)."""
+    """Min-EWMA-latency live connection (unix wins ties), with epsilon
+    softmax exploration across transports."""
+    conns = list(peer.conns.items())
+    if not conns:
+        return None
+    if len(conns) > 1 and _bandit_rng.random() < _BANDIT_EXPLORE:
+        lats = [c.latency.value for _, c in conns]
+        lo = min(lats)
+        # Temperature tracks the spread so even a much-slower transport
+        # keeps a real probability (the whole point is re-measuring it).
+        temp = max((max(lats) - lo) / 2.0, 1e-6)
+        weights = [math.exp(-(l - lo) / temp) for l in lats]
+        r = _bandit_rng.random() * sum(weights)
+        for (_, conn), w in zip(conns, weights):
+            r -= w
+            if r <= 0:
+                return conn
+        return conns[-1][1]
     best, best_key = None, None
-    for t, conn in peer.conns.items():
+    for t, conn in conns:
         key = (conn.latency.value, 0 if t == "unix" else 1)
         if best_key is None or key < best_key:
             best, best_key = conn, key

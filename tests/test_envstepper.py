@@ -110,3 +110,33 @@ def test_concurrent_clients_from_threads(served_pool):
         t.join(timeout=120)
     assert not errors, errors
     assert all(len(v) == 10 for v in results.values())
+
+
+def test_stale_step_rejected_and_lease_reclaim():
+    """A buffer freed and re-acquired must reject the old owner's steps, and
+    a silently-dead client's buffer is reclaimed after the lease expires."""
+    pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=1)
+    srv_rpc = Rpc("env-server")
+    srv_rpc.listen("127.0.0.1:0")
+    server = EnvPoolServer(srv_rpc, pool, lease_timeout=0.5)
+    addr = srv_rpc.debug_info()["listen"][0]
+    try:
+        rpc_a, a = _client(addr, "actor-a")
+        a.step(np.zeros(4, np.int64)).result(timeout=60)
+        # actor-a dies silently (no close): simulate by just not releasing.
+        import time as _time
+
+        _time.sleep(0.7)
+        rpc_b, b = _client(addr, "actor-b")  # lease expired: reclaims
+        assert b.batch_index == 0
+        b.step(np.zeros(4, np.int64)).result(timeout=60)
+        # The stale owner's step is rejected, not silently executed.
+        with pytest.raises(RpcError, match="not owned"):
+            a.step(np.zeros(4, np.int64)).result(timeout=60)
+        b.close()
+        rpc_a.close()
+        rpc_b.close()
+    finally:
+        server.close()
+        srv_rpc.close()
+        pool.close()

@@ -130,3 +130,48 @@ def test_vtrace_transformer_smoke():
     logs = vtrace_train(cfg, log_fn=_quiet)
     assert logs and logs[-1]["updates"] >= 1
     assert np.isfinite(logs[-1]["total_loss"])
+
+
+def test_vtrace_nethack_smoke():
+    """Benchmark config 5's stack end to end: dict observations (glyphs +
+    blstats) through EnvPool, two-stage batching, NetHackNet LSTM, V-trace."""
+    cfg = VtraceConfig(
+        env="nethack",
+        num_actions=23,
+        use_lstm=True,
+        total_steps=1_500,
+        actor_batch_size=4,
+        learn_batch_size=4,
+        virtual_batch_size=4,
+        num_actor_processes=2,
+        unroll_length=5,
+        log_interval_steps=500,
+        stats_interval=1e9,
+        compute_dtype="float32",
+        seed=0,
+    )
+    logs = vtrace_train(cfg, log_fn=_quiet)
+    assert logs and logs[-1]["updates"] >= 1
+    assert np.isfinite(logs[-1]["total_loss"])
+
+
+def test_vtrace_procgen_smoke():
+    """Benchmark config 4's stack: 64x64x3 ProcGen-shaped pixels through the
+    ResNet encoder (synthetic stand-in when procgen isn't installed)."""
+    cfg = VtraceConfig(
+        env="procgen",
+        num_actions=15,
+        total_steps=1_000,
+        actor_batch_size=4,
+        learn_batch_size=4,
+        virtual_batch_size=4,
+        num_actor_processes=2,
+        unroll_length=5,
+        log_interval_steps=500,
+        stats_interval=1e9,
+        compute_dtype="float32",
+        seed=0,
+    )
+    logs = vtrace_train(cfg, log_fn=_quiet)
+    assert logs and logs[-1]["updates"] >= 1
+    assert np.isfinite(logs[-1]["total_loss"])

@@ -256,3 +256,20 @@ def test_debug_info(pair):
     assert "host" in info["peers"]
     conns = info["peers"]["host"]["connections"]
     assert any(c["latency_ms"] >= 0 for c in conns.values())
+
+
+def test_transport_bandit_explores():
+    """The softmax bandit keeps routing occasional traffic to a slower
+    transport (so its EWMA can recover), while argmin dominates."""
+    import types
+
+    from moolib_tpu.rpc import rpc as rpc_mod
+
+    fast = types.SimpleNamespace(latency=types.SimpleNamespace(value=0.001))
+    slow = types.SimpleNamespace(latency=types.SimpleNamespace(value=0.050))
+    peer = types.SimpleNamespace(conns={"unix": fast, "tcp": slow})
+    picks = {id(fast): 0, id(slow): 0}
+    for _ in range(5000):
+        picks[id(rpc_mod._best_conn(peer))] += 1
+    assert picks[id(slow)] > 0  # exploration happens
+    assert picks[id(fast)] > picks[id(slow)] * 10  # argmin dominates
