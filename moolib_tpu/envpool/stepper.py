@@ -112,10 +112,16 @@ class EnvPoolServer:
                 del self._owners[idx]
                 self._free.append(idx)
 
-    def _release(self, batch_index: int):
+    def _release(self, batch_index: int, client: Optional[str] = None):
         with self._lock:
-            if self._owners.pop(batch_index, None) is None:
+            owner = self._owners.get(batch_index)
+            if owner is None:
                 return False
+            if client is not None and owner != client:
+                # Stale release from a lease-evicted client: the buffer
+                # belongs to someone else now — do not free it under them.
+                return False
+            del self._owners[batch_index]
         if self.pool.busy(batch_index):
             # The closing client still has a step executing (its ::step
             # handler is blocked in the pool); freeing the buffer now would
@@ -200,7 +206,8 @@ class RemoteEnvStepper:
             self._closed = True
             try:
                 self.rpc.async_(
-                    self.server, f"{self.name}::release", self.batch_index
+                    self.server, f"{self.name}::release", self.batch_index,
+                    self.rpc.get_name(),
                 ).result(10.0)
             except Exception:
                 pass  # server gone: buffer dies with it

@@ -244,48 +244,53 @@ class SyntheticNetHack:
 def create_procgen(env_name: str = "coinrun", index: int = 0,
                    num_actions: int = 15):
     """ProcGen factory: the real gym3 env when procgen is installed, else
-    the synthetic ProcGen-shaped stand-in (same contract)."""
+    the synthetic ProcGen-shaped stand-in (same contract).
+
+    Only a missing package falls back; any other failure (typo'd env name,
+    API mismatch) RAISES — silently training on the synthetic env while
+    reporting "ProcGen" numbers would be worse than failing.
+    """
     try:
         import gym
-
-        env = gym.make(
-            f"procgen:procgen-{env_name}-v0", start_level=index,
-            num_levels=0, distribution_mode="easy",
-        )
-
-        class _Gym21(  # procgen ships the old gym API; adapt to gymnasium's
-            object
-        ):
-            num_actions = env.action_space.n
-
-            def reset(self, seed=None):
-                return env.reset(), {}
-
-            def step(self, action):
-                # No internal auto-reset: the EnvPool worker owns the reset
-                # on done (doubling it would burn a level generation and
-                # skip an episode per boundary).
-                obs, reward, done, info = env.step(int(action))
-                return obs, float(reward), bool(done), False, info
-
-        return _Gym21()
-    except Exception:
+        import procgen  # noqa: F401
+    except ImportError:
         return SyntheticProcgen(num_actions=num_actions, seed=index)
+
+    env = gym.make(
+        f"procgen:procgen-{env_name}-v0", start_level=index,
+        num_levels=0, distribution_mode="easy",
+    )
+
+    class _Gym21:  # procgen ships the old gym API; adapt to gymnasium's
+        num_actions = env.action_space.n
+
+        def reset(self, seed=None):
+            return env.reset(), {}
+
+        def step(self, action):
+            # No internal auto-reset: the EnvPool worker owns the reset
+            # on done (doubling it would burn a level generation and
+            # skip an episode per boundary).
+            obs, reward, done, info = env.step(int(action))
+            return obs, float(reward), bool(done), False, info
+
+    return _Gym21()
 
 
 def create_nethack(index: int = 0, num_actions: int = 23):
     """NetHack factory: the real NLE env when nle is installed, else the
-    synthetic NetHack-shaped stand-in (same dict-obs contract)."""
+    synthetic NetHack-shaped stand-in (same dict-obs contract). Only a
+    missing package falls back; real-env construction errors raise."""
     try:
         import gymnasium
         import nle  # noqa: F401
-
-        env = gymnasium.make("NetHackScore-v0",
-                             observation_keys=("glyphs", "blstats"))
-        env.reset(seed=index)
-        return env
-    except Exception:
+    except ImportError:
         return SyntheticNetHack(num_actions=num_actions, seed=index)
+
+    env = gymnasium.make("NetHackScore-v0",
+                         observation_keys=("glyphs", "blstats"))
+    env.reset(seed=index)
+    return env
 
 
 def create_cartpole(index: int = 0, prefer_gymnasium: bool = True):

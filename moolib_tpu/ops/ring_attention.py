@@ -27,11 +27,18 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .attention import _finalize, _mask_bias, _online_block, _scale
 
-__all__ = ["ring_attention", "sequence_sharded_attention"]
+__all__ = [
+    "ring_attention",
+    "sequence_sharded_attention",
+    "zigzag_order",
+    "zigzag_ring_attention",
+    "zigzag_sharded_attention",
+]
 
 
 def ring_attention(
@@ -180,3 +187,210 @@ def sequence_sharded_attention(
             out_specs=seq_spec,
         )
     )(q, k, v, segment_ids)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag (striped) causal ring attention: load-balanced sequence parallelism.
+#
+# Plain ring attention with contiguous shards is causally imbalanced: device
+# n-1's queries attend to every shard (n folds) while device 0's attend only
+# to their own — wall-clock is set by the busiest device even with step
+# skipping. The zigzag layout splits the sequence into 2n chunks and gives
+# device d chunks (d, 2n-1-d); for every (q-chunk a, k-chunk b) pair the
+# causal decision is chunk-level (a > b: full fold, a == b: triangle,
+# a < b: skip), and each device ends up with exactly 2n+1 allowed chunk
+# folds per full ring pass — identical on every device. This is the
+# "striped attention" / context-parallel layout used for long-context
+# training; no reference counterpart (the reference has no attention at
+# all, SURVEY.md §5).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _zigzag_order_cached(n: int, seq_len: int):
+    if seq_len % (2 * n) != 0:
+        raise ValueError(f"seq_len {seq_len} not divisible by 2n={2 * n}")
+    tc = seq_len // (2 * n)
+    chunks = []
+    for d in range(n):
+        chunks += [d, 2 * n - 1 - d]
+    perm = np.concatenate([np.arange(c * tc, (c + 1) * tc) for c in chunks])
+    perm.setflags(write=False)
+    inv = np.argsort(perm)
+    inv.setflags(write=False)
+    return perm, inv
+
+
+def zigzag_order(n: int, seq_len: int) -> np.ndarray:
+    """Gather indices reordering a global [.., S, ..] sequence so contiguous
+    n-way sharding gives device d chunks (d, 2n-1-d). Invert with argsort."""
+    return _zigzag_order_cached(n, seq_len)[0]
+
+
+def zigzag_ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str = "sp",
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+):
+    """Causal attention over zigzag-laid-out per-device shards.
+
+    Per-device inputs are [B, H, 2*Tc, D]: rows [:Tc] are global chunk
+    ``idx`` and rows [Tc:] chunk ``2n-1-idx`` (produce the layout with
+    :func:`zigzag_order`; :func:`zigzag_sharded_attention` does it for you).
+    Causality is implicit in the layout — there is no ``causal=False``.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, T2, D = q.shape
+    if T2 % 2 != 0:
+        raise ValueError("zigzag shard length must be even (two chunks)")
+    tc = T2 // 2
+    qf = _scale(q.astype(jnp.float32))
+
+    if segment_ids is None and kv_segment_ids is not None:
+        raise ValueError("kv_segment_ids without segment_ids")
+    use_seg = segment_ids is not None
+    carry_seg = (
+        kv_segment_ids if kv_segment_ids is not None else segment_ids
+    )
+    if carry_seg is None:
+        carry_seg = jnp.zeros((B, T2), jnp.int32)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    tri = jnp.where(
+        jnp.arange(tc)[:, None] >= jnp.arange(tc)[None, :], 0.0, -1e30
+    )  # [Tc, Tc] causal triangle, valid whenever q-chunk == k-chunk
+
+    def fold_chunk(qc, kc, vc, segq_c, segk_c, a, b, mla):
+        """Fold k-chunk ``b`` into q-chunk ``a``'s online-softmax state.
+        Chunk-level causality: a < b skip, a == b triangle, a > b full."""
+
+        def seg_bias():
+            same = segq_c[:, None, :, None] == segk_c[:, None, None, :]
+            return jnp.where(same, 0.0, -1e30)
+
+        def do_skip(mla):
+            return mla
+
+        def do_tri(mla):
+            bias = tri + seg_bias() if use_seg else tri
+            return _online_block(qc, kc, vc, bias, *mla)
+
+        def do_full(mla):
+            bias = seg_bias() if use_seg else None
+            return _online_block(qc, kc, vc, bias, *mla)
+
+        branch = jnp.clip(jnp.sign(a - b) + 1, 0, 2)
+        return jax.lax.switch(branch, [do_skip, do_tri, do_full], mla)
+
+    qc0, qc1 = qf[..., :tc, :], qf[..., tc:, :]
+    a0, a1 = idx, 2 * n - 1 - idx
+    seg_local = segment_ids if use_seg else jnp.zeros((B, T2), jnp.int32)
+    sq0, sq1 = seg_local[:, :tc], seg_local[:, tc:]
+
+    def step(carry, i):
+        kb, vb, segb, mla0, mla1 = carry
+        src = (idx - i) % n
+        b0, b1 = src, 2 * n - 1 - src
+        kc0, kc1 = kb[..., :tc, :], kb[..., tc:, :]
+        vc0, vc1 = vb[..., :tc, :], vb[..., tc:, :]
+        sk0, sk1 = segb[:, :tc], segb[:, tc:]
+        kc0, kc1 = kc0.astype(jnp.float32), kc1.astype(jnp.float32)
+        vc0, vc1 = vc0.astype(jnp.float32), vc1.astype(jnp.float32)
+        mla0 = fold_chunk(qc0, kc0, vc0, sq0, sk0, a0, b0, mla0)
+        mla0 = fold_chunk(qc0, kc1, vc1, sq0, sk1, a0, b1, mla0)
+        mla1 = fold_chunk(qc1, kc0, vc0, sq1, sk0, a1, b0, mla1)
+        mla1 = fold_chunk(qc1, kc1, vc1, sq1, sk1, a1, b1, mla1)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        segb = jax.lax.ppermute(segb, axis_name, perm)
+        return (kb, vb, segb, mla0, mla1), None
+
+    def pv(x):
+        vma = getattr(jax.typeof(x), "vma", frozenset())
+        if axis_name in vma:
+            return x
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        return jax.lax.pvary(x, (axis_name,))
+
+    def zero_mla():
+        return (
+            pv(jnp.full((B, H, tc), -jnp.inf, jnp.float32)),
+            pv(jnp.zeros((B, H, tc), jnp.float32)),
+            pv(jnp.zeros((B, H, tc, D), jnp.float32)),
+        )
+
+    (kb, vb, segb, mla0, mla1), _ = jax.lax.scan(
+        step, (k, v, pv(carry_seg), zero_mla(), zero_mla()), jnp.arange(n)
+    )
+    out0 = _finalize(*mla0, v.dtype)
+    out1 = _finalize(*mla1, v.dtype)
+    return jnp.concatenate([out0, out1], axis=-2)
+
+
+@functools.lru_cache(maxsize=16)
+def _zigzag_jitted(mesh: Mesh, axis_name: str, use_seg: bool):
+    """Memoized jitted shard_map wrapper — a fresh jit per call would
+    retrace/recompile every training step."""
+    seq_spec = P(None, None, axis_name, None)
+    seg_spec = P(None, axis_name)
+    if not use_seg:
+
+        def f(q, k, v):
+            return zigzag_ring_attention(q, k, v, axis_name=axis_name)
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=mesh,
+                in_specs=(seq_spec, seq_spec, seq_spec),
+                out_specs=seq_spec,
+            )
+        )
+
+    def f(q, k, v, seg):
+        return zigzag_ring_attention(
+            q, k, v, axis_name=axis_name, segment_ids=seg,
+            kv_segment_ids=seg,
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(seq_spec, seq_spec, seq_spec, seg_spec),
+            out_specs=seq_spec,
+        )
+    )
+
+
+def zigzag_sharded_attention(
+    mesh: Mesh,
+    q,
+    k,
+    v,
+    axis_name: str = "sp",
+    segment_ids: Optional[jax.Array] = None,
+):
+    """Causal zigzag ring attention over globally-shaped arrays: permutes
+    the sequence into zigzag order, shards [B, H, S, D] along S, runs
+    :func:`zigzag_ring_attention` inside shard_map, and un-permutes.
+
+    Convenience API for globally-shaped data: the permute/un-permute gathers
+    materialize full [B, H, S, D] arrays. Training loops at scale should
+    instead keep data in zigzag layout end to end (apply
+    :func:`zigzag_order` once at the data layout level) and call
+    :func:`zigzag_ring_attention` inside their own shard_map.
+    """
+    n = mesh.shape[axis_name]
+    S = q.shape[-2]
+    perm, inv = _zigzag_order_cached(n, S)
+    qz, kz, vz = q[..., perm, :], k[..., perm, :], v[..., perm, :]
+    fn = _zigzag_jitted(mesh, axis_name, segment_ids is not None)
+    if segment_ids is None:
+        out = fn(qz, kz, vz)
+    else:
+        out = fn(qz, kz, vz, segment_ids[..., perm])
+    return out[..., inv, :]
