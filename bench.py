@@ -22,6 +22,9 @@ NORTH_STAR_PER_CHIP = 1_000_000 / 32  # env-steps/sec/chip share
 
 
 def main() -> None:
+    from moolib_tpu.utils.benchmark import install_watchdog
+
+    watchdog = install_watchdog("impala_train_env_steps_per_sec_per_chip")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -37,6 +40,8 @@ def main() -> None:
     from moolib_tpu.parallel.mesh import make_mesh, shard_batch
 
     devices = jax.devices()
+    if watchdog is not None:
+        watchdog.cancel()  # tunnel reachable: never kill a slow-but-live run
     n_chips = len(devices)
 
     # Unroll/frame shape mirrors the reference's vtrace example defaults

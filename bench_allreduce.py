@@ -131,6 +131,9 @@ def bench_rpc_tree(n_peers: int = 4, sizes=(2**16, 2**20, 2**23)):
 
 
 def bench_ici_psum(sizes=(2**20, 2**23, 2**25)):
+    from moolib_tpu.utils.benchmark import install_watchdog
+
+    watchdog = install_watchdog("ici_psum_gbps")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -139,6 +142,8 @@ def bench_ici_psum(sizes=(2**20, 2**23, 2**25)):
     from moolib_tpu.parallel.mesh import make_mesh
 
     n = len(jax.devices())
+    if watchdog is not None:
+        watchdog.cancel()
     if n < 2:
         print(json.dumps({
             "plane": "ici_psum", "peers": n,

@@ -22,8 +22,13 @@ import time
 
 def main(duration: float = 60.0) -> None:
     from moolib_tpu.utils import ensure_platforms
+    from moolib_tpu.utils.benchmark import install_watchdog
 
     ensure_platforms()
+    # Generous: covers duration + compile; fires only on a dead tunnel.
+    install_watchdog(
+        "impala_e2e_env_steps_per_sec", default_seconds=duration + 1800
+    )
 
     from moolib_tpu.examples.vtrace.experiment import VtraceConfig, train
 

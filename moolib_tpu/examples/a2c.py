@@ -32,7 +32,6 @@ from moolib_tpu.examples.common import (
     StatSum,
     Stats,
 )
-from moolib_tpu.examples.envs import create_cartpole
 
 __all__ = ["A2CConfig", "train", "a2c_loss"]
 
@@ -44,6 +43,11 @@ class A2CConfig:
     entropy cost 0.0006, adam eps 3e-7)."""
 
     total_steps: int = 50_000
+    # "cartpole" | "synthetic" (Atari-shaped pixels) | an ALE id like
+    # "ALE/Pong-v5" (driver benchmark config 2: A2C on Atari Pong, one
+    # chip, no cross-peer Accumulator needed — though it still works).
+    env: str = "cartpole"
+    num_actions: int = 6  # pixel envs only (cartpole is 2)
     unroll_length: int = 64
     batch_size: int = 4  # envs per peer
     num_processes: int = 2
@@ -136,7 +140,7 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
         make_grad_step,
         make_train_state,
     )
-    from moolib_tpu.models import A2CNet
+    from moolib_tpu.models import A2CNet, ImpalaNet
 
     broker = None
     broker_addr = cfg.broker
@@ -148,15 +152,27 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
     rpc.listen("127.0.0.1:0")
     rpc.connect(broker_addr)
 
-    net = A2CNet(
-        num_actions=2,
-        hidden_sizes=(cfg.hidden_size, cfg.hidden_size),
-        use_lstm=cfg.use_lstm,
-        lstm_size=cfg.hidden_size,
-    )
+    if cfg.env == "cartpole":
+        net = A2CNet(
+            num_actions=2,
+            hidden_sizes=(cfg.hidden_size, cfg.hidden_size),
+            use_lstm=cfg.use_lstm,
+            lstm_size=cfg.hidden_size,
+        )
+        dummy_obs = jnp.zeros((1, 1, 4), jnp.float32)
+    else:
+        # Pixel A2C (benchmark config 2): the IMPALA ResNet torso with the
+        # same A2C loss/update — single-chip, no algorithmic change.
+        net = ImpalaNet(
+            num_actions=cfg.num_actions,
+            use_lstm=cfg.use_lstm,
+            compute_dtype=jnp.bfloat16
+            if jax.default_backend() == "tpu"
+            else jnp.float32,
+        )
+        dummy_obs = jnp.zeros((1, 1, 84, 84, 4), jnp.uint8)
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)
-    dummy_obs = jnp.zeros((1, 1, 4), jnp.float32)
     dummy_done = jnp.zeros((1, 1), bool)
     params = net.init(init_rng, dummy_obs, dummy_done, net.initial_state(1))
     optimizer = optax.chain(
@@ -193,8 +209,10 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
         set_state=set_state,
     )
 
+    from moolib_tpu.examples.envs import make_env_fn
+
     pool = moolib_tpu.EnvPool(
-        create_cartpole,
+        make_env_fn(cfg.env, num_actions=cfg.num_actions),
         num_processes=cfg.num_processes,
         batch_size=cfg.batch_size,
         num_batches=cfg.num_batches,
@@ -312,6 +330,10 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--total-steps", type=int, default=A2CConfig.total_steps)
+    p.add_argument("--env", type=str, default=A2CConfig.env,
+                   help="cartpole | synthetic | an ALE id (ALE/Pong-v5)")
+    p.add_argument("--num-actions", type=int, default=A2CConfig.num_actions,
+                   help="action count for pixel envs")
     p.add_argument("--batch-size", type=int, default=A2CConfig.batch_size)
     p.add_argument("--unroll-length", type=int,
                    default=A2CConfig.unroll_length)
@@ -327,6 +349,8 @@ def main():
     args = p.parse_args()
     cfg = A2CConfig(
         total_steps=args.total_steps,
+        env=args.env,
+        num_actions=args.num_actions,
         batch_size=args.batch_size,
         unroll_length=args.unroll_length,
         num_processes=args.num_processes,

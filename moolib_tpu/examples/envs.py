@@ -37,6 +37,7 @@ __all__ = [
     "create_atari",
     "create_nethack",
     "create_procgen",
+    "make_env_fn",
 ]
 
 
@@ -291,6 +292,30 @@ def create_nethack(index: int = 0, num_actions: int = 23):
                          observation_keys=("glyphs", "blstats"))
     env.reset(seed=index)
     return env
+
+
+def make_env_fn(env: str, num_actions: int = 6, episode_length: int = 200):
+    """Single source for example env selection (shared by the a2c and
+    vtrace entry points): "cartpole" | "synthetic" | "nethack" |
+    "procgen[:name]" | an ALE id."""
+    import functools
+
+    if env == "cartpole":
+        return create_cartpole
+    if env == "synthetic":
+        return functools.partial(
+            create_synthetic_atari,
+            num_actions=num_actions,
+            episode_length=episode_length,
+        )
+    if env == "nethack":
+        return functools.partial(create_nethack, num_actions=num_actions)
+    if env == "procgen" or env.startswith("procgen:"):
+        name = env.split(":", 1)[1] if ":" in env else "coinrun"
+        return functools.partial(
+            create_procgen, name, num_actions=num_actions
+        )
+    return functools.partial(create_atari, env)
 
 
 def create_cartpole(index: int = 0, prefer_gymnasium: bool = True):

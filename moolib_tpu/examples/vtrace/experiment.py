@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-import functools
 import os
 import time
 from typing import List, Optional
@@ -91,26 +90,12 @@ class VtraceConfig:
 
 
 def _make_env_fn(cfg: VtraceConfig):
-    if cfg.env == "cartpole":
-        return env_factories.create_cartpole
-    if cfg.env == "synthetic":
-        return functools.partial(
-            env_factories.create_synthetic_atari,
-            num_actions=cfg.num_actions,
-            episode_length=cfg.episode_length,
-        )
-    if cfg.env == "nethack":  # benchmark config 5 (real NLE when installed)
-        return functools.partial(
-            env_factories.create_nethack, num_actions=cfg.num_actions
-        )
-    if cfg.env == "procgen" or cfg.env.startswith("procgen:"):
-        # benchmark config 4 (real procgen when installed)
-        name = cfg.env.split(":", 1)[1] if ":" in cfg.env else "coinrun"
-        return functools.partial(
-            env_factories.create_procgen, name,
-            num_actions=cfg.num_actions,
-        )
-    return functools.partial(env_factories.create_atari, cfg.env)
+    # Shared factory selection ("nethack" = benchmark config 5,
+    # "procgen[:name]" = config 4; real packages used when installed).
+    return env_factories.make_env_fn(
+        cfg.env, num_actions=cfg.num_actions,
+        episode_length=cfg.episode_length,
+    )
 
 
 def _make_model(cfg: VtraceConfig):

@@ -650,6 +650,9 @@ class Accumulator:
                 "cumulative_batch_size": self._cumulative_bs,
                 "count_rounds": self._seq,
                 "gradient_rounds": self._gseq,
+                "gradient_rounds_inflight": self._grads_inflight,
+                "results_queued": len(self._results),
+                "parallel_gradients": self._parallel,
                 "leader": self._leader,
                 "synced": self._synced,
             }

@@ -14,10 +14,50 @@ quotes steps/s) lives HERE, once:
 
 from __future__ import annotations
 
+import json
+import os
+import threading
 import time
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
-__all__ = ["time_train_step"]
+__all__ = ["time_train_step", "install_watchdog"]
+
+
+def install_watchdog(
+    metric: str,
+    default_seconds: float = 1200.0,
+    env_var: str = "MOOLIB_BENCH_WATCHDOG",
+) -> Optional[threading.Timer]:
+    """Abort with a parseable JSON diagnostic instead of hanging forever if
+    the device tunnel is unreachable (observed: a down tunnel blocks
+    ``jax.devices()`` indefinitely, which would hang a driver-run benchmark
+    with no output at all).
+
+    Returns the timer — CANCEL it as soon as device enumeration succeeds,
+    so a healthy-but-slow run is never killed. ``env_var=0`` disables.
+    """
+    seconds = float(os.environ.get(env_var, default_seconds))
+    if seconds <= 0:
+        return None
+
+    def boom():
+        print(
+            json.dumps(
+                {
+                    "metric": metric,
+                    "value": None,
+                    "error": f"bench watchdog fired after {seconds}s "
+                    "(device tunnel unreachable?)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, boom)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def time_train_step(

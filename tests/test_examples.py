@@ -175,3 +175,23 @@ def test_vtrace_procgen_smoke():
     logs = vtrace_train(cfg, log_fn=_quiet)
     assert logs and logs[-1]["updates"] >= 1
     assert np.isfinite(logs[-1]["total_loss"])
+
+
+def test_a2c_pixel_smoke():
+    """A2C with the ResNet torso on Atari-shaped pixels (benchmark config 2:
+    A2C on Atari — synthetic stand-in in CI)."""
+    from moolib_tpu.examples.a2c import A2CConfig, train as a2c_train
+
+    cfg = A2CConfig(
+        env="synthetic",
+        num_actions=6,
+        total_steps=600,
+        unroll_length=5,
+        batch_size=2,
+        num_processes=2,
+        log_interval_steps=300,
+        seed=0,
+    )
+    logs = a2c_train(cfg, log_fn=_quiet)
+    assert logs and logs[-1]["updates"] >= 1
+    assert np.isfinite(logs[-1]["total_loss"])
