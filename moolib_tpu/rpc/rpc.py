@@ -919,7 +919,10 @@ class Rpc:
                     target = conn
                 if target is not None:
                     self._loop.create_task(self._write(target, frames))
-            self._loop.call_soon_threadsafe(_send)
+            try:
+                self._loop.call_soon_threadsafe(_send)
+            except RuntimeError:
+                pass  # Rpc closed while a handler was finishing: reply moot
 
         handler(respond, obj)
 

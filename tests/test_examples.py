@@ -195,3 +195,68 @@ def test_a2c_pixel_smoke():
     logs = a2c_train(cfg, log_fn=_quiet)
     assert logs and logs[-1]["updates"] >= 1
     assert np.isfinite(logs[-1]["total_loss"])
+
+
+@pytest.mark.integration
+def test_remote_actors_learner():
+    """SEED-style split: two thin actor loops feed a central learner over
+    RPC — policy served via define(batch_size=, pad=True) inference
+    batching, unrolls shipped into a define_queue (the reference's
+    EnvStepper/central-inference topology)."""
+    import threading
+
+    from moolib_tpu.examples.remote_actors import (
+        RemoteConfig,
+        run_actor,
+        run_learner,
+    )
+
+    cfg = RemoteConfig(
+        env="cartpole",
+        actor_batch_size=2,
+        num_env_processes=2,
+        unroll_length=5,
+        infer_batch_size=4,
+        learn_batch_size=4,
+        total_updates=20,   # exit as soon as the work is done...
+        max_seconds=120,    # ...with a generous safety cap
+        log_interval=0.5,
+    )
+    addr_box = {}
+    addr_ready = threading.Event()
+
+    def on_ready(addr):
+        addr_box["addr"] = addr
+        addr_ready.set()
+
+    logs_box = {}
+
+    def learner():
+        logs_box["logs"] = run_learner(
+            cfg, log_fn=_quiet, ready_fn=on_ready
+        )
+
+    lt = threading.Thread(target=learner)
+    lt.start()
+    assert addr_ready.wait(30), "learner never reported its address"
+
+    frames = []
+    actors = [
+        threading.Thread(
+            target=lambda: frames.append(
+                run_actor(cfg, addr_box["addr"], max_seconds=60)
+            )
+        )
+        for _ in range(2)
+    ]
+    for t in actors:
+        t.start()
+    lt.join(timeout=150)
+    assert not lt.is_alive(), "learner never reached total_updates"
+    for t in actors:  # actors break cleanly once the learner is gone
+        t.join(timeout=90)
+        assert not t.is_alive()
+    assert sum(frames) > 0
+    rows = logs_box["logs"]
+    assert rows and rows[-1]["updates"] >= 1
+    assert np.isfinite(rows[-1]["total_loss"])
