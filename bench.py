@@ -89,7 +89,12 @@ def main() -> None:
     from moolib_tpu.utils.benchmark import time_train_step
 
     iters = 10
-    state, dt, _compile_s = time_train_step(step, state, batch, iters=iters)
+    # MOOLIB_BENCH_PROFILE=<dir> captures an XLA trace of the timed run
+    # only (never the compile, which would drown the timeline).
+    state, dt, _compile_s = time_train_step(
+        step, state, batch, iters=iters,
+        trace_dir=os.environ.get("MOOLIB_BENCH_PROFILE"),
+    )
 
     steps_per_sec = iters * T * B / dt
     per_chip = steps_per_sec / max(1, n_chips)

@@ -79,6 +79,7 @@ class VtraceConfig:
     broker: Optional[str] = None  # None -> in-process broker
     group: str = "vtrace"
     savedir: Optional[str] = None
+    profile_dir: Optional[str] = None  # capture an XLA trace of updates 10-13
     wandb: bool = False  # log rows to wandb when the package is available
     wandb_project: str = "moolib_tpu"
     checkpoint_interval: float = 600.0
@@ -294,6 +295,9 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
         except Exception as e:
             log_fn(f"wandb disabled ({e}); logging to tsv only")
     logs: List[dict] = []
+    from moolib_tpu.utils.profiling import StepWindowProfiler
+
+    profiler = StepWindowProfiler(cfg.profile_dir)
 
     # --- env pool ----------------------------------------------------------
     pool = moolib_tpu.EnvPool(
@@ -402,6 +406,10 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                     # Version label for the params apply_step produces —
                     # model_version itself can advance on RPC threads.
                     applied_version = accumulator.result_model_version()
+                    # BEFORE the update: result() counts completed updates,
+                    # i.e. the 0-based index of the one about to run — so
+                    # the [start, stop) window captures exactly those.
+                    profiler.step(int(stats["updates"].result()))
                     state = apply_step(
                         state, jax.tree_util.tree_map(jnp.asarray, mean_grads)
                     )
@@ -450,6 +458,7 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                 )
                 window.reset()
     finally:
+        profiler.close()
         pool.close()
         learn_batcher.close()
         accumulator.close()

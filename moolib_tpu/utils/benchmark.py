@@ -61,14 +61,19 @@ def install_watchdog(
 
 
 def time_train_step(
-    step: Callable, state, batch, iters: int = 10
+    step: Callable, state, batch, iters: int = 10,
+    trace_dir: Optional[str] = None,
 ) -> Tuple[Any, float, float]:
     """Time ``iters`` chained ``step(state, batch) -> (state, metrics)``
     calls under the honest protocol.
 
     Returns ``(final_state, timed_seconds, compile_seconds)`` — throughput
-    is ``iters * items_per_step / timed_seconds``.
+    is ``iters * items_per_step / timed_seconds``. With ``trace_dir``, an
+    XLA profiler trace captures ONLY the timed run (compilation and warmup
+    would otherwise dwarf the steady-state timeline).
     """
+    import contextlib
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -91,8 +96,15 @@ def time_train_step(
     float(fp)
     compile_s = time.perf_counter() - t_c
 
-    t0 = time.perf_counter()
-    state, fp = run_many(state, batch)
-    assert np.isfinite(float(fp))  # D2H readback: forces real completion
-    dt = time.perf_counter() - t0
+    if trace_dir:
+        from .profiling import profile_trace
+
+        ctx = profile_trace(trace_dir)
+    else:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        t0 = time.perf_counter()
+        state, fp = run_many(state, batch)
+        assert np.isfinite(float(fp))  # D2H readback: forces real completion
+        dt = time.perf_counter() - t0
     return state, dt, compile_s

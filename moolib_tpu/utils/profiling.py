@@ -1,0 +1,69 @@
+"""Profiler capture: the tracing half of the observability story.
+
+The reference's tracing is flamegraph-style host tracing of its C++ threads
+(reference: src/moolib.cc trace hooks / py/moolib docs). On TPU the
+actionable trace is XLA's: ``jax.profiler`` captures device timelines
+(MXU occupancy, HBM traffic, collective overlap) viewable in TensorBoard
+or Perfetto. This wraps it with a zero-dependency context manager and a
+step-window helper so experiments can capture exactly N steps without
+instrumenting their loops twice.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+__all__ = ["profile_trace", "StepWindowProfiler"]
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str) -> Iterator[None]:
+    """Capture a jax profiler trace into ``logdir`` for the duration of the
+    with-block (view with TensorBoard's profile plugin or Perfetto)."""
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    with jax.profiler.trace(logdir):
+        yield
+
+
+class StepWindowProfiler:
+    """Capture steps [start, stop) of a training loop.
+
+    >>> prof = StepWindowProfiler(logdir, start=10, stop=13)
+    >>> for step in range(n):
+    ...     prof.step(step)   # starts/stops the capture at the window edges
+    ...     train_step(...)
+    >>> prof.close()          # safety: stop if the loop exited early
+
+    Skipping the first steps avoids tracing compilation, which would dwarf
+    the steady-state timeline.
+    """
+
+    def __init__(self, logdir: Optional[str], start: int = 10, stop: int = 13):
+        self.logdir = logdir
+        self.start = start
+        self.stop = stop
+        self._active = False
+
+    def step(self, step_index: int) -> None:
+        if self.logdir is None:
+            return
+        import jax
+
+        if not self._active and self.start <= step_index < self.stop:
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif self._active and step_index >= self.stop:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False

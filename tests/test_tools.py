@@ -73,3 +73,28 @@ def test_broker_cli_prints_address():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_profile_trace_capture(tmp_path):
+    """profile_trace writes an XLA trace; StepWindowProfiler opens/closes
+    around the configured window without leaking an active trace."""
+    import jax.numpy as jnp
+
+    from moolib_tpu.utils.profiling import StepWindowProfiler, profile_trace
+
+    d = str(tmp_path / "trace")
+    with profile_trace(d):
+        float(jnp.ones((8, 8)).sum())
+    assert any(os.scandir(d)), "no trace files captured"
+
+    p = StepWindowProfiler(str(tmp_path / "w"), start=2, stop=4)
+    for i in range(6):
+        p.step(i)
+        float(jnp.ones((4, 4)).sum())
+    p.close()
+    assert any(os.scandir(str(tmp_path / "w")))
+
+    # Disabled profiler is a no-op.
+    p2 = StepWindowProfiler(None)
+    p2.step(0)
+    p2.close()
