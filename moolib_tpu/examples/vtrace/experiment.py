@@ -79,7 +79,9 @@ class VtraceConfig:
     broker: Optional[str] = None  # None -> in-process broker
     group: str = "vtrace"
     savedir: Optional[str] = None
-    profile_dir: Optional[str] = None  # capture an XLA trace of updates 10-13
+    # Capture an XLA trace of updates [10, 13) — 3 steady-state updates,
+    # compilation excluded.
+    profile_dir: Optional[str] = None
     wandb: bool = False  # log rows to wandb when the package is available
     wandb_project: str = "moolib_tpu"
     checkpoint_interval: float = 600.0

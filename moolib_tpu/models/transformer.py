@@ -21,7 +21,11 @@ Design:
   MLP; attention backend selectable: ``dense`` (short T), ``blockwise``
   (O(T) memory), ``flash`` (pallas TPU kernel), ``ring`` (sequence-parallel
   across the ``sp`` mesh axis — call inside shard_map with the T axis
-  sharded and pass globally-correct ``segment_ids``/``positions``).
+  sharded and pass globally-correct ``segment_ids``/``positions``), or
+  ``zigzag`` (the load-balanced causal layout: apply
+  :func:`moolib_tpu.ops.ring_attention.zigzag_order` to the T axis of
+  obs/done/segment_ids/positions before shard_map; every device then does
+  equal causal work).
 """
 
 from __future__ import annotations
@@ -68,6 +72,14 @@ class _SelfAttention(nn.Module):
                 q, k, v, axis_name=self.ring_axis, causal=True,
                 segment_ids=seg_bt, kv_segment_ids=seg_bt,
             )
+        elif self.backend == "zigzag":
+            # Caller feeds zigzag-laid-out shards (zigzag_order applied to
+            # the T axis of obs/done/segment_ids/positions before
+            # shard_map) — causal work then balances across the sp axis.
+            o = ring_ops.zigzag_ring_attention(
+                q, k, v, axis_name=self.ring_axis,
+                segment_ids=seg_bt, kv_segment_ids=seg_bt,
+            )
         else:
             o = attn_ops.attention(
                 q, k, v, backend=self.backend, causal=True,
@@ -105,7 +117,7 @@ class TransformerNet(nn.Module):
     num_heads: int = 4
     mlp_ratio: int = 4
     max_len: int = 2048
-    attention_backend: str = "auto"  # dense|blockwise|flash|ring|auto
+    attention_backend: str = "auto"  # dense|blockwise|flash|ring|zigzag|auto
     ring_axis: str = "sp"
     compute_dtype: jnp.dtype = jnp.float32
 
@@ -127,6 +139,15 @@ class TransformerNet(nn.Module):
             x = nn.Dense(self.d_model)(x)
 
         if positions is None:
+            if self.attention_backend in ("ring", "zigzag"):
+                # A local arange would silently embed wrong positions on
+                # every shard past the first — same failure class as the
+                # segment_ids check below, so same loud error.
+                raise ValueError(
+                    f"{self.attention_backend} backend needs globally-"
+                    "correct positions for each local shard (zigzag: in "
+                    "zigzag_order layout)"
+                )
             positions = jnp.arange(T)
         pos_emb = nn.Embed(self.max_len, self.d_model, name="pos_emb")(
             positions
@@ -134,11 +155,12 @@ class TransformerNet(nn.Module):
         x = x + pos_emb[:, None, :].astype(self.compute_dtype)
 
         if segment_ids is None:
-            if self.attention_backend == "ring":
+            if self.attention_backend in ("ring", "zigzag"):
                 raise ValueError(
-                    "ring backend needs globally-correct segment_ids; "
-                    "compute them from the full done sequence before "
-                    "shard_map and pass the local shard in"
+                    f"{self.attention_backend} backend needs "
+                    "globally-correct segment_ids; compute them from the "
+                    "full done sequence before shard_map and pass the "
+                    "local shard in (zigzag: in zigzag_order layout)"
                 )
             segment_ids = segment_ids_from_done(done)
 
