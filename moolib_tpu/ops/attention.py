@@ -15,10 +15,12 @@ Three implementations, one contract ``[B, H, T, D] -> [B, H, T, D]``:
   a ``lax.scan`` over key/value blocks carrying the online-softmax state
   (m, l, acc), so peak memory is O(T·block) instead of O(T²) and reverse-mode
   differentiation works out of the box (scan transposes cleanly).
-- :func:`flash_attention` — the pallas TPU kernel for the forward pass
+- :func:`flash_attention` — pallas TPU kernels for BOTH passes: forward
   (grid over (batch·heads, q-blocks, k-blocks), f32 VMEM accumulators,
-  online softmax), with a custom VJP whose backward recomputes via
-  :func:`blockwise_attention` — O(T) memory end to end.
+  online softmax, per-row log-sum-exp emitted for the backward) and the
+  FlashAttention backward (a dQ kernel and a dK/dV kernel that rebuild P
+  from the saved lse — no second softmax, no O(T²) residuals), O(T)
+  memory end to end with causal block skipping in all three kernels.
 
 All three support causal masking and ``segment_ids`` (attention is blocked
 across segment boundaries — used by the transformer agent to stop attention
@@ -101,12 +103,17 @@ def _online_block(q, k, v, bias, m, l, acc):
     if bias is not None:
         s = s + bias
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-    # Block rows that are fully masked keep m == -inf; exp(s - m) would be
-    # exp(0)=1 garbage, so guard the shift.
-    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-    p = jnp.exp(s - shift[..., None])
+    # Rows whose max is still at the mask floor are fully masked: treat as
+    # -inf (same `> _NEG_INF/2` rule as the pallas kernel, so every online-
+    # softmax variant yields ZEROS for fully-masked rows instead of the
+    # finite-bias uniform degeneracy) and guard the exp shift.
+    masked = m_new <= _NEG_INF / 2
+    shift = jnp.where(masked, 0.0, m_new)
+    p = jnp.where(
+        masked[..., None], 0.0, jnp.exp(s - shift[..., None])
+    )
     scale_old = jnp.where(
-        jnp.isfinite(m), jnp.exp(m - shift), jnp.zeros_like(m)
+        m > _NEG_INF / 2, jnp.exp(m - shift), jnp.zeros_like(m)
     )
     l_new = l * scale_old + jnp.sum(p, axis=-1)
     acc_new = acc * scale_old[..., None] + jnp.einsum(
@@ -199,12 +206,30 @@ def blockwise_attention(
 # ---------------------------------------------------------------------------
 
 
+def _tile_bias(s_like, causal, qi, ki, block_q, block_k, seg_q, seg_k):
+    """Additive mask for one (q-block, k-block) tile — the ONE definition
+    shared by the forward and both backward kernels, so the masks can
+    never diverge."""
+    bias = jnp.zeros_like(s_like)
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s_like.shape, 0
+        )
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s_like.shape, 1
+        )
+        bias = jnp.where(qpos >= kpos, bias, _NEG_INF)
+    same = seg_q[:, None] == seg_k[None, :]
+    return jnp.where(same, bias, _NEG_INF)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref,
-                  m_sc, l_sc, acc_sc, *, causal: bool, block_q: int,
-                  block_k: int, n_k: int):
+                  lse_ref, m_sc, l_sc, acc_sc, *, causal: bool,
+                  block_q: int, block_k: int, n_k: int):
     """Grid: (B*H, Tq//block_q, Tk//block_k); k-axis is the sequential
     ('arbitrary') dimension carrying the online-softmax state in VMEM
-    scratch. q/k/v blocks arrive pre-staged by BlockSpec."""
+    scratch. q/k/v blocks arrive pre-staged by BlockSpec. Also emits the
+    per-row log-sum-exp (lse) the backward kernels rebuild P from."""
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -227,19 +252,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref,
         v = v_ref[0].astype(jnp.float32)
 
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-
-        bias = jnp.zeros_like(s)
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            bias = jnp.where(qpos >= kpos, bias, _NEG_INF)
-        same = seg_q_ref[0, 0][:, None] == seg_k_ref[0, 0][None, :]
-        bias = jnp.where(same, bias, _NEG_INF)
-        s = s + bias
+        s = s + _tile_bias(
+            s, causal, qi, ki, block_q, block_k, seg_q_ref[0, 0],
+            seg_k_ref[0, 0],
+        )
 
         m_prev = m_sc[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -259,6 +275,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref, o_ref,
         l = l_sc[:]
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_sc[:] / safe_l[:, None]).astype(o_ref.dtype)
+        # lse = m + log(l); +inf for fully-masked rows so exp(s - lse) = 0
+        # in the backward regardless of s.
+        m = m_sc[:]
+        shift = jnp.where(m > _NEG_INF / 2, m, 0.0)
+        lse_ref[0, 0] = jnp.where(
+            l > 0, shift + jnp.log(safe_l), jnp.inf
+        )
 
 
 try:  # pallas is TPU/interpret-only; import lazily-ish at module load
@@ -299,7 +322,7 @@ def _flash_forward(q, k, v, seg_q, seg_k, causal, block_q, block_k,
         _flash_kernel, causal=causal, block_q=block_q, block_k=block_k,
         n_k=n_k,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // block_q, n_k),
         in_specs=[
@@ -309,8 +332,14 @@ def _flash_forward(q, k, v, seg_q, seg_k, causal, block_q, block_k,
             pl.BlockSpec((1, 1, block_q), lambda b, qi, ki: (b, 0, qi)),
             pl.BlockSpec((1, 1, block_k), lambda b, qi, ki: (b, 0, ki)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), v.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, qi, ki: (b, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), v.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, Tq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q,), jnp.float32),
@@ -321,7 +350,189 @@ def _flash_forward(q, k, v, seg_q, seg_k, causal, block_q, block_k,
         ),
         interpret=interpret,
     )(qr, kr, vr, segq, segk)
-    return out.reshape(B, H, Tq, D)
+    return out.reshape(B, H, Tq, D), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref,
+                         lse_ref, delta_ref, do_ref, dq_ref, dq_sc, *,
+                         causal: bool, block_q: int, block_k: int,
+                         n_k: int):
+    """dQ pass. Grid (B*H, n_q, n_k); k-axis sequential, dq accumulates in
+    VMEM scratch. P is rebuilt from the saved lse (no second softmax)."""
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    visible = (
+        ki * block_k <= qi * block_q + block_q - 1 if causal else ki >= 0
+    )
+
+    @pl.when(visible)
+    def _compute():
+        scale = 1.0 / np.sqrt(q_ref.shape[-1])
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = s + _tile_bias(
+            s, causal, qi, ki, block_q, block_k, seg_q_ref[0, 0],
+            seg_k_ref[0, 0],
+        )
+        # exp(-inf - +inf) is nan, not 0: clamp fully-masked rows' lse.
+        safe_lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        p = jnp.where(
+            jnp.isfinite(lse)[:, None], jnp.exp(s - safe_lse[:, None]), 0.0
+        )
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dq_sc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ki == n_k - 1)
+    def _done():
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_k_ref,
+                           lse_ref, delta_ref, do_ref, dk_ref, dv_ref,
+                           dk_sc, dv_sc, *, causal: bool, block_q: int,
+                           block_k: int, n_q: int):
+    """dK/dV pass. Grid (B*H, n_k, n_q); q-axis sequential, dk/dv
+    accumulate in VMEM scratch."""
+    kj, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    # A q-block strictly above this k-block sees none of it.
+    visible = (
+        qi * block_q + block_q - 1 >= kj * block_k if causal else qi >= 0
+    )
+
+    @pl.when(visible)
+    def _compute():
+        scale = 1.0 / np.sqrt(q_ref.shape[-1])
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = s + _tile_bias(
+            s, causal, qi, kj, block_q, block_k, seg_q_ref[0, 0],
+            seg_k_ref[0, 0],
+        )
+        safe_lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        p = jnp.where(
+            jnp.isfinite(lse)[:, None], jnp.exp(s - safe_lse[:, None]), 0.0
+        )
+        dv_sc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_sc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _done():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, seg_q, seg_k, out, lse, g, causal, block_q,
+                    block_k, interpret):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[-2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    n_q, n_k = Tq // block_q, Tk // block_k
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    gr = g.reshape(B * H, Tq, D)
+    segq = jnp.broadcast_to(seg_q[:, None, :], (B, H, Tq)).reshape(
+        B * H, 1, Tq
+    )
+    segk = jnp.broadcast_to(seg_k[:, None, :], (B, H, Tk)).reshape(
+        B * H, 1, Tk
+    )
+    # delta_i = rowsum(dO * O): the softmax-jacobian correction term.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(B * H, 1, Tq)
+
+    q_spec = pl.BlockSpec((1, block_q, D), lambda b, x, y: (b, x, 0))
+    row_q = pl.BlockSpec((1, 1, block_q), lambda b, x, y: (b, 0, x))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, causal=causal, block_q=block_q,
+            block_k=block_k, n_k=n_k,
+        ),
+        grid=(B * H, n_q, n_k),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, qi, ki: (b, ki, 0)),
+            row_q,
+            pl.BlockSpec((1, 1, block_k), lambda b, qi, ki: (b, 0, ki)),
+            row_q,
+            row_q,
+            q_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, segq, segk, lse, delta, gr)
+
+    k_spec = pl.BlockSpec((1, block_k, D), lambda b, kj, qi: (b, kj, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel, causal=causal, block_q=block_q,
+            block_k=block_k, n_q=n_q,
+        ),
+        grid=(B * H, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, kj, qi: (b, qi, 0)),
+            k_spec,
+            k_spec,
+            pl.BlockSpec((1, 1, block_q), lambda b, kj, qi: (b, 0, qi)),
+            pl.BlockSpec((1, 1, block_k), lambda b, kj, qi: (b, 0, kj)),
+            pl.BlockSpec((1, 1, block_q), lambda b, kj, qi: (b, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda b, kj, qi: (b, 0, qi)),
+            pl.BlockSpec((1, block_q, D), lambda b, kj, qi: (b, qi, 0)),
+        ],
+        out_specs=[k_spec, k_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qr, kr, vr, segq, segk, lse, delta, gr)
+
+    return (
+        dq.reshape(B, H, Tq, D),
+        dk.reshape(B, H, Tk, D),
+        dv.reshape(B, H, Tk, D),
+    )
 
 
 @functools.partial(
@@ -329,30 +540,25 @@ def _flash_forward(q, k, v, seg_q, seg_k, causal, block_q, block_k,
 )
 def _flash_attention(q, k, v, seg_q, seg_k, causal, block_q, block_k,
                      interpret):
-    return _flash_forward(
+    out, _lse = _flash_forward(
         q, k, v, seg_q, seg_k, causal, block_q, block_k, interpret
     )
+    return out
 
 
 def _flash_fwd(q, k, v, seg_q, seg_k, causal, block_q, block_k, interpret):
-    out = _flash_forward(
+    out, lse = _flash_forward(
         q, k, v, seg_q, seg_k, causal, block_q, block_k, interpret
     )
-    return out, (q, k, v, seg_q, seg_k)
+    return out, (q, k, v, seg_q, seg_k, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v, seg_q, seg_k = res
-
-    # O(T)-memory backward: differentiate the blockwise recomputation.
-    def f(q, k, v):
-        return blockwise_attention(
-            q, k, v, causal=causal, segment_ids=seg_q, kv_segment_ids=seg_k,
-            block_k=block_k,
-        )
-
-    _, vjp = jax.vjp(f, q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, seg_q, seg_k, out, lse = res
+    dq, dk, dv = _flash_backward(
+        q, k, v, seg_q, seg_k, out, lse, g, causal, block_q, block_k,
+        interpret,
+    )
     return dq, dk, dv, None, None
 
 
@@ -430,6 +636,18 @@ def _probe_flash(block_q: int, block_k: int) -> bool:
                 flash_attention(
                     q, kv, kv, causal=True, block_q=block_q, block_k=block_k
                 )
+            )
+            # The backward kernels are separate Mosaic programs: probe them
+            # too, or 'auto' could poison the caller's grad compile.
+            jax.block_until_ready(
+                jax.grad(
+                    lambda q: jnp.sum(
+                        flash_attention(
+                            q, kv, kv, causal=True,
+                            block_q=block_q, block_k=block_k,
+                        )
+                    )
+                )(q)
             )
             ok = True
         except Exception as e:  # Mosaic lowering/compile rejection

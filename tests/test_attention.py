@@ -357,3 +357,41 @@ def test_transformer_zigzag_backend_matches_dense():
     np.testing.assert_allclose(
         np.asarray(b_z)[inv], np.asarray(b_ref), rtol=3e-5, atol=3e-5
     )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_kernel_with_segments(rng, causal):
+    """The pallas backward (dQ + dK/dV kernels rebuilt from the saved lse)
+    must match oracle gradients under segment masking, including
+    fully-masked rows (unmatchable q segment => zero gradient, not NaN).
+    Reference is blockwise_attention: like flash it returns zeros for
+    fully-masked rows, where the finite-bias dense oracle degenerates to
+    uniform attention."""
+    B, H, T, D = 2, 2, 64, 16
+    q, k, v = _qkv(rng, B=B, H=H, T=T, D=D)
+    seg = _segs(rng, B=B, T=T)
+    # Lane 0's first rows get a segment no key has: fully masked.
+    seg_q = seg.at[0, :4].set(999)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(
+            blockwise_attention(q, k, v, causal=causal, segment_ids=seg_q,
+                                kv_segment_ids=seg, block_k=16) ** 2
+        )
+
+    def flash_loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, segment_ids=seg_q,
+                            kv_segment_ids=seg, block_q=16,
+                            block_k=16) ** 2
+        )
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        assert np.isfinite(np.asarray(b)).all()
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+    # The fully-masked rows' q gradients are exactly zero.
+    np.testing.assert_array_equal(np.asarray(g_fl[0])[0, :, :4, :], 0.0)
