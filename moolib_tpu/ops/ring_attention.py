@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .attention import _finalize, _mask_bias, _online_block, _scale
+from .attention import _finalize, _online_block, _scale
 
 __all__ = [
     "ring_attention",

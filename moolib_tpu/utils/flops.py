@@ -17,7 +17,7 @@ per layer).
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 __all__ = [
     "conv2d_flops",
