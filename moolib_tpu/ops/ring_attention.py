@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .attention import _finalize, _online_block, _scale
+from ..parallel.mesh import pvary_if_needed
 
 __all__ = [
     "ring_attention",
@@ -128,12 +129,7 @@ def ring_attention(
     # body makes them device-varying, so the initial carry must be marked
     # varying too (shard_map vma typing).
     def pv(x):  # no-op if already varying (e.g. real segment-id shards)
-        vma = getattr(jax.typeof(x), "vma", frozenset())
-        if axis_name in vma:
-            return x
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, (axis_name,), to="varying")
-        return jax.lax.pvary(x, (axis_name,))
+        return pvary_if_needed(x, axis_name)
 
     m0 = pv(jnp.full((B, H, T), -jnp.inf, jnp.float32))
     l0 = pv(jnp.zeros((B, H, T), jnp.float32))
@@ -310,12 +306,7 @@ def zigzag_ring_attention(
         return (kb, vb, segb, mla0, mla1), None
 
     def pv(x):
-        vma = getattr(jax.typeof(x), "vma", frozenset())
-        if axis_name in vma:
-            return x
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, (axis_name,), to="varying")
-        return jax.lax.pvary(x, (axis_name,))
+        return pvary_if_needed(x, axis_name)
 
     def zero_mla():
         return (

@@ -9,6 +9,8 @@ from .mesh import (
     replicated_spec,
     shard_batch,
 )
+from .moe import moe_ffn, moe_params
+from .pipeline import pipeline_apply, stack_stage_params
 from .tp import (
     impala_tp_specs,
     shard_params,
@@ -30,4 +32,8 @@ __all__ = [
     "shard_params",
     "sharded_init_opt_state",
     "transformer_tp_specs",
+    "moe_ffn",
+    "moe_params",
+    "pipeline_apply",
+    "stack_stage_params",
 ]

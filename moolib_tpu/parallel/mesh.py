@@ -7,8 +7,10 @@ ICI mesh inside the jitted train step, per the design note in SURVEY.md §5).
 
 Axis convention used across the framework:
   - ``dp``: data parallel (gradient psum rides here)
-  - ``tp``: tensor/model parallel
-  - ``sp``: sequence/context parallel (ring attention)
+  - ``tp``: tensor/model parallel (Megatron-sharded params, parallel/tp.py)
+  - ``sp``: sequence/context parallel (ring/zigzag attention)
+  - ``pp``: pipeline parallel (GPipe microbatching, parallel/pipeline.py)
+  - ``ep``: expert parallel (MoE expert sharding, parallel/moe.py)
 """
 
 from __future__ import annotations
@@ -29,31 +31,51 @@ __all__ = [
     "shard_batch",
     "batch_leaf_spec",
     "batch_specs",
+    "pvary_if_needed",
 ]
+
+
+def pvary_if_needed(x, axis_name: str):
+    """Mark a value device-varying over ``axis_name`` for shard_map's vma
+    typing (no-op if already varying). Needed when a fresh constant enters
+    a scan whose body makes it varying — the initial carry must match."""
+    vma = getattr(jax.typeof(x), "vma", frozenset())
+    if axis_name in vma:
+        return x
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    return jax.lax.pvary(x, (axis_name,))
 
 
 def make_mesh(
     dp: Optional[int] = None,
     tp: int = 1,
     sp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (dp, tp, sp) mesh over the available devices.
+    """Build a (dp, tp, sp, pp, ep) mesh over the available devices.
 
-    ``dp`` defaults to "whatever is left": n_devices // (tp * sp).
+    ``dp`` defaults to "whatever is left": n_devices // (tp * sp * pp * ep).
+    Size-1 axes cost nothing — specs that never name them are unaffected.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    rest = tp * sp * pp * ep
     if dp is None:
-        if n % (tp * sp) != 0:
-            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
-        dp = n // (tp * sp)
-    if dp * tp * sp != n:
+        if n % rest != 0:
+            raise ValueError(
+                f"{n} devices not divisible by tp*sp*pp*ep={rest}"
+            )
+        dp = n // rest
+    if dp * rest != n:
         raise ValueError(
-            f"mesh {dp}x{tp}x{sp} needs {dp * tp * sp} devices, have {n}"
+            f"mesh {dp}x{tp}x{sp}x{pp}x{ep} needs {dp * rest} devices, "
+            f"have {n}"
         )
-    arr = np.asarray(devices).reshape(dp, tp, sp)
-    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+    arr = np.asarray(devices).reshape(dp, tp, sp, pp, ep)
+    return Mesh(arr, axis_names=("dp", "tp", "sp", "pp", "ep"))
 
 
 def data_parallel_spec() -> P:

@@ -1,0 +1,105 @@
+"""Expert parallelism: a mixture-of-experts FFN sharded over an ``ep``
+mesh axis.
+
+The reference has no expert parallelism (SURVEY.md §2.5) — TPU-first scope
+completing the mesh-axis portfolio. The design is the standard
+Switch-style top-1 MoE mapped to XLA collectives:
+
+- router (replicated linear) scores tokens per expert;
+- each token goes to its argmax expert, subject to a fixed per-expert
+  ``capacity`` (static shapes: XLA cannot compile data-dependent sizes, so
+  overflow tokens are dropped and pass through the residual unchanged —
+  the standard Switch Transformer behavior);
+- dispatch/combine are einsums against a one-hot dispatch mask; with
+  experts sharded over ``ep`` (one or more experts per device), the
+  dispatch einsum IS the all-to-all — XLA inserts the collective from the
+  shardings, no hand-written a2a;
+- combine scales each token's expert output by its router probability so
+  the router receives gradients.
+
+``moe_ffn`` is pure (call under jit/shard_map); :func:`moe_params` builds
+the parameter pytree with an expert-major leading axis to shard with
+``P('ep', ...)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["moe_params", "moe_ffn"]
+
+
+def moe_params(
+    rng: jax.Array,
+    d_model: int,
+    d_hidden: int,
+    num_experts: int,
+    dtype=jnp.float32,
+) -> Dict[str, Any]:
+    """Router + expert FFN weights; expert leaves are [E, ...] (shard the
+    leading axis over ``ep``)."""
+    k_r, k_1, k_2 = jax.random.split(rng, 3)
+    scale1 = 1.0 / jnp.sqrt(d_model)
+    scale2 = 1.0 / jnp.sqrt(d_hidden)
+    return {
+        "router": (
+            jax.random.normal(k_r, (d_model, num_experts), dtype) * scale1
+        ),
+        "w_up": (
+            jax.random.normal(k_1, (num_experts, d_model, d_hidden), dtype)
+            * scale1
+        ),
+        "w_down": (
+            jax.random.normal(k_2, (num_experts, d_hidden, d_model), dtype)
+            * scale2
+        ),
+    }
+
+
+def moe_ffn(params: Dict[str, Any], x: jax.Array, capacity: int):
+    """Top-1 MoE FFN. ``x``: [T, d_model] tokens; returns ([T, d_model],
+    aux) where aux carries the load-balancing loss term and drop fraction.
+
+    Works replicated or with expert-sharded params: under jit with
+    ``w_up``/``w_down`` sharded ``P('ep', None, None)``, XLA partitions the
+    dispatch/expert/combine einsums over ``ep`` and inserts the
+    all-to-all-shaped collectives itself.
+    """
+    T, d_model = x.shape
+    E = params["router"].shape[-1]
+    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    # Position of each token within its expert's capacity buffer.
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [T, E]
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # 1-based
+    pos = jnp.max(pos_in_expert, axis=-1) - 1  # [T], -1 never happens
+    kept = pos < capacity
+    # dispatch[t, e, c] = 1 iff token t sits in slot c of expert e.
+    dispatch = (
+        jax.nn.one_hot(expert, E, dtype=x.dtype)[:, :, None]
+        * jax.nn.one_hot(pos, capacity, dtype=x.dtype)[:, None, :]
+        * kept[:, None, None].astype(x.dtype)
+    )  # [T, E, C]
+
+    xe = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, d_model]
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edh->ech", xe, params["w_up"].astype(x.dtype))
+    )
+    ye = jnp.einsum("ech,ehd->ecd", h, params["w_down"].astype(x.dtype))
+    y = jnp.einsum("tec,ecd->td", dispatch, ye)  # [T, d_model]
+    y = y * gate[:, None].astype(y.dtype)  # router gets gradients
+
+    # Switch load-balancing loss: E * sum_e f_e * p_e.
+    frac_tokens = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = {
+        "load_balance_loss": E * jnp.sum(frac_tokens * frac_probs),
+        "drop_fraction": 1.0 - jnp.mean(kept.astype(jnp.float32)),
+    }
+    return y, aux

@@ -1,0 +1,104 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a ``pp``
+mesh axis.
+
+The reference has no pipeline parallelism (its only strategy is elastic DP,
+SURVEY.md §2.5) — this is TPU-first scope completing the mesh-axis
+portfolio (dp/tp/sp/pp/ep). The construction is the classic JAX SPMD
+pipeline: every device holds ONE stage's parameters; microbatches enter at
+stage 0, activations hop stage-to-stage with ``lax.ppermute`` inside a
+``lax.scan`` over ``n_micro + n_stages - 1`` ticks (the bubble), and the
+last stage collects outputs. All devices execute the same program — stage
+identity is data (``axis_index``), exactly how XLA wants SPMD control flow.
+
+Differentiability is free: scan + ppermute transpose cleanly, so the
+backward pass is the reverse pipeline (activations flow backward along the
+ring) without a custom VJP.
+
+Constraints (standard for ppermute pipelines): every stage maps activations
+of one shape to the SAME shape ([microbatch, features] -> same), and stage
+parameters must be a pytree stacked on a leading stage axis sharded over
+``pp`` (see :func:`stack_stage_params`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import pvary_if_needed
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(param_list) -> Any:
+    """Stack per-stage parameter pytrees on a new leading axis: shard the
+    result over ``pp`` (e.g. ``P('pp', ...)``) so each device holds its
+    stage's slice."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *param_list
+    )
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params: Any,
+    microbatches: jax.Array,
+    axis_name: str = "pp",
+):
+    """Run ``microbatches`` through the stage pipeline. Call INSIDE
+    shard_map (uses ``axis_index``).
+
+    Args:
+      stage_fn: ``(params, x_mb) -> y_mb`` for ONE stage; activation shape
+        preserved.
+      stage_params: this device's stage slice — leaves with leading dim 1
+        (from a ``P('pp', ...)``-sharded stack built by
+        :func:`stack_stage_params`).
+      microbatches: ``[n_micro, mb, ...]`` — identical (replicated) on all
+        pipeline devices.
+
+    Returns ``[n_micro, mb, ...]`` outputs, replicated across the axis.
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    # Forward-only chain: stage d sends to d+1; stage 0 receives nothing
+    # (ppermute delivers zeros to unlisted destinations, which stage 0
+    # ignores — it reads from `microbatches`).
+    perm = [(d, d + 1) for d in range(n_stages - 1)]
+
+    def pv(x):
+        return pvary_if_needed(x, axis_name)
+
+    act0 = pv(jnp.zeros_like(microbatches[0]))
+    out0 = pv(jnp.zeros_like(microbatches))
+
+    def tick(carry, t):
+        act_in, out = carry
+        # Stage 0 feeds microbatch t (clamped: ticks past n_micro push
+        # bubble garbage that never reaches the output window).
+        mb_t = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        x = jnp.where(idx == 0, mb_t, act_in)
+        y = stage_fn(params, x)
+        # Last stage stores microbatch t-(n_stages-1) once it emerges.
+        pos = t - (n_stages - 1)
+        store = jnp.logical_and(idx == n_stages - 1, pos >= 0)
+        stored = jax.lax.dynamic_update_index_in_dim(
+            out, y.astype(out.dtype), jnp.clip(pos, 0, n_micro - 1), 0
+        )
+        out = jnp.where(store, stored, out)
+        act_next = jax.lax.ppermute(y, axis_name, perm)
+        return (act_next, out), None
+
+    (_, out), _ = jax.lax.scan(
+        tick, (act0, out0), jnp.arange(n_micro + n_stages - 1)
+    )
+    # Replicate the last stage's collected outputs to every pipeline device
+    # (everyone else holds zeros).
+    mask = (idx == n_stages - 1).astype(out.dtype)
+    return jax.lax.psum(out * mask, axis_name)

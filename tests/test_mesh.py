@@ -18,9 +18,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 def test_make_mesh_shapes():
     mesh = make_mesh()
-    assert mesh.devices.shape == (8, 1, 1)
+    assert mesh.devices.shape == (8, 1, 1, 1, 1)
     mesh2 = make_mesh(tp=2, sp=2)
-    assert mesh2.devices.shape == (2, 2, 2)
+    assert mesh2.devices.shape == (2, 2, 2, 1, 1)
+    mesh3 = make_mesh(pp=2, ep=2)
+    assert mesh3.devices.shape == (2, 1, 1, 2, 2)
     with pytest.raises(ValueError):
         make_mesh(dp=3, tp=3)
 
