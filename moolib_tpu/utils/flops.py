@@ -23,6 +23,7 @@ __all__ = [
     "conv2d_flops",
     "dense_flops",
     "lstm_flops",
+    "impala_layer_walk",
     "impala_forward_flops",
     "impala_train_flops",
     "device_peak_flops",
@@ -48,7 +49,7 @@ def lstm_flops(d_in: int, hidden: int) -> int:
     return 2 * 4 * hidden * (d_in + hidden)
 
 
-def impala_forward_flops(
+def impala_layer_walk(
     height: int = 84,
     width: int = 84,
     in_channels: int = 4,
@@ -57,27 +58,49 @@ def impala_forward_flops(
     num_actions: int = 6,
     use_lstm: bool = False,
     lstm_size: int = 256,
-) -> int:
-    """Forward FLOPs per frame for ImpalaNet (models/impala.py).
+):
+    """Yield per-layer records for ImpalaNet (models/impala.py):
+    ``(name, flops_per_frame, contraction_k, output_lanes_n, out_elems)``.
 
-    Mirrors the architecture exactly: per ConvSequence one 3x3 conv at the
-    incoming resolution, a stride-2 SAME max-pool, then two residual blocks
-    (four 3x3 convs) at the pooled resolution. 84x84 input pools 84→42→21→11.
+    The single source of truth for the architecture walk — both
+    :func:`impala_forward_flops` (the benchmark's MFU denominator) and
+    ``tools/roofline.py`` (the MXU tile-efficiency table) consume it, so the
+    two cannot drift. Mirrors the model exactly: per ConvSequence one 3x3
+    conv at the incoming resolution, a stride-2 SAME max-pool, then two
+    residual blocks (four 3x3 convs) at the pooled resolution; 84x84 input
+    pools 84→42→21→11; then the FC trunk, optional LSTM, and both heads.
+
+    ``contraction_k`` / ``output_lanes_n`` are the implicit-matmul dims the
+    MXU sees (convs: K = kh*kw*c_in, N = c_out).
     """
     h, w, c = height, width, in_channels
-    total = 0
-    for ch in channels:
-        total += conv2d_flops(h, w, 3, 3, c, ch)
+    for i, ch in enumerate(channels):
+        yield (f"s{i}.conv {c}->{ch} @{h}x{w}",
+               conv2d_flops(h, w, 3, 3, c, ch), 9 * c, ch, h * w * ch)
         h, w = math.ceil(h / 2), math.ceil(w / 2)  # SAME pool, stride 2
-        total += 4 * conv2d_flops(h, w, 3, 3, ch, ch)
+        for j in range(4):
+            yield (f"s{i}.res{j // 2}.conv{j % 2} {ch}->{ch} @{h}x{w}",
+                   conv2d_flops(h, w, 3, 3, ch, ch), 9 * ch, ch, h * w * ch)
         c = ch
-    total += dense_flops(h * w * c, hidden_size)
+    d_in = h * w * c
+    yield (f"dense {d_in}->{hidden_size}", dense_flops(d_in, hidden_size),
+           d_in, hidden_size, hidden_size)
     if use_lstm:
-        total += lstm_flops(hidden_size, lstm_size)
+        # 4 gates over [x; h]: one matmul of K = in+hidden, N = 4*hidden.
+        yield (f"lstm {hidden_size}+{lstm_size}",
+               lstm_flops(hidden_size, lstm_size),
+               hidden_size + lstm_size, 4 * lstm_size, lstm_size)
         hidden_size = lstm_size
-    total += dense_flops(hidden_size, num_actions)  # policy head
-    total += dense_flops(hidden_size, 1)  # baseline head
-    return total
+    yield (f"policy head {hidden_size}->{num_actions}",
+           dense_flops(hidden_size, num_actions),
+           hidden_size, num_actions, num_actions)
+    yield (f"baseline head {hidden_size}->1",
+           dense_flops(hidden_size, 1), hidden_size, 1, 1)
+
+
+def impala_forward_flops(**kw) -> int:
+    """Forward FLOPs per frame for ImpalaNet — sum of the layer walk."""
+    return sum(rec[1] for rec in impala_layer_walk(**kw))
 
 
 def impala_train_flops(frames: int, **kw) -> int:
