@@ -22,8 +22,13 @@ NORTH_STAR_PER_CHIP = 1_000_000 / 32  # env-steps/sec/chip share
 
 
 def main() -> None:
-    from moolib_tpu.utils.benchmark import install_watchdog
+    from moolib_tpu.utils.benchmark import install_watchdog, wait_for_device
 
+    # Tunnel-flap resilience: probe liveness in subprocesses (bounded by
+    # MOOLIB_BENCH_BUDGET, default 1800s) and only then init jax in-process.
+    # A tunnel that comes back mid-budget is caught within one probe
+    # interval; exhaustion emits the null artifact with the probe history.
+    probe = wait_for_device("impala_train_env_steps_per_sec_per_chip")
     watchdog = install_watchdog("impala_train_env_steps_per_sec_per_chip")
     import jax
     import jax.numpy as jnp
@@ -117,6 +122,8 @@ def main() -> None:
                 "mfu": round(achieved / peak, 4) if peak else None,
                 "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
                 "device_kind": devices[0].device_kind,
+                "tunnel_probe_attempts": probe["attempts"],
+                "tunnel_waited_s": probe["waited_s"],
             }
         )
     )

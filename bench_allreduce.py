@@ -144,9 +144,16 @@ def bench_ici_psum(sizes=(2**20, 2**23, 2**25)):
     n = len(jax.devices())
     if watchdog is not None:
         watchdog.cancel()
+    # A psum over a virtual CPU mesh measures XLA:CPU thread scheduling,
+    # not ICI — label it so it cannot be read as an interconnect number
+    # (VERDICT r3 weak #2).
+    platform = jax.devices()[0].platform
+    plane = "ici_psum" if platform == "tpu" else (
+        f"{platform}_psum_protocol_check"
+    )
     if n < 2:
         print(json.dumps({
-            "plane": "ici_psum", "peers": n,
+            "plane": plane, "peers": n,
             "note": "single device: psum is a no-op, nothing to measure",
         }))
         return
@@ -175,7 +182,7 @@ def bench_ici_psum(sizes=(2**20, 2**23, 2**25)):
         dt = (time.perf_counter() - t0) / rounds
         gbps = size * 4 * n / dt / 1e9
         print(json.dumps({
-            "plane": "ici_psum", "peers": n,
+            "plane": plane, "peers": n,
             "mb": round(size * 4 / 1e6, 2),
             "ms": round(dt * 1e3, 2), "gbps": round(gbps, 3),
         }))

@@ -22,9 +22,10 @@ import time
 
 def main(duration: float = 60.0) -> None:
     from moolib_tpu.utils import ensure_platforms
-    from moolib_tpu.utils.benchmark import install_watchdog
+    from moolib_tpu.utils.benchmark import install_watchdog, wait_for_device
 
     ensure_platforms()
+    probe = wait_for_device("impala_e2e_env_steps_per_sec")
     # Generous: covers duration + compile; fires only on a dead tunnel.
     install_watchdog(
         "impala_e2e_env_steps_per_sec", default_seconds=duration + 1800
@@ -66,6 +67,8 @@ def main(duration: float = 60.0) -> None:
                 "unit": "env-steps/s (1 peer, acting+batching+H2D+train)",
                 "total_env_steps": int(total_steps),
                 "wall_s": round(elapsed, 1),
+                "tunnel_probe_attempts": probe["attempts"],
+                "tunnel_waited_s": probe["waited_s"],
                 "learner_only_gap_note": (
                     "bench.py measures the resident-batch train step alone; "
                     "the difference to this number is host pipeline cost "

@@ -85,11 +85,23 @@ class _Ctrl:
         self.cmd_sems = [base + w * sem for w in range(n_workers)]
         done_base = base + n_workers * sem
         self.done_sems = [done_base + b * sem for b in range(num_batches)]
-        ring_base = _align(done_base + num_batches * sem)
+        # One any-buffer-progressed semaphore: lets a single parent thread
+        # block for completion across ALL buffers (callback dispatch) instead
+        # of polling per-buffer sems. Workers post it ONLY while notify_flag
+        # is set (the parent sets it when it starts draining): a pool used
+        # purely via blocking result() would otherwise accumulate posts
+        # until sem_post hits SEM_VALUE_MAX and crashes the worker.
+        self.notify_sem = done_base + num_batches * sem
+        self.notify_flag = self.notify_sem + sem  # u32
+        ring_base = _align(self.notify_flag + 4)
         self.rings = [
             ring_base + w * (_RING + 1) * 4 for w in range(n_workers)
         ]
         self.end = ring_base + n_workers * (_RING + 1) * 4
+
+    def flag_view(self, buf) -> np.ndarray:
+        return np.ndarray((1,), np.uint32, buffer=buf,
+                          offset=self.notify_flag)
 
     def ring_views(self, buf, w: int):
         """(slots u32[_RING], tail u32[1]) views for worker w.
@@ -218,6 +230,7 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
                 # buffer's done semaphore.
                 cmd_off = ctrl.cmd_sems[rank]
                 slots, tail_w = ctrl.ring_views(shm.buf, rank)
+                notify_flag = ctrl.flag_view(shm.buf)
                 while True:
                     # Periodic timeout so a vanished parent (no CLOSE ever
                     # arriving) doesn't strand the worker forever: the still-
@@ -239,6 +252,8 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
                         return
                     step_slice(b)
                     native.sem_post(shm.buf, ctrl.done_sems[b])
+                    if notify_flag[0]:
+                        native.sem_post(shm.buf, ctrl.notify_sem)
             else:
                 while True:
                     try:
@@ -275,14 +290,26 @@ class EnvStepperFuture:
         self._pool = pool
         self._batch_index = batch_index
         self._event = event
+        self._has_callback = False
 
     def result(self, timeout: Optional[float] = None):
         pool = self._pool
-        if pool._ctrl is not None:
+        if pool._ctrl is not None and not self._has_callback:
             pool._wait_native(self._batch_index, timeout)
         elif not self._event.wait(timeout):
             raise TimeoutError("EnvStepperFuture.result timed out")
         return pool._collect(self._batch_index)
+
+    def add_done_callback(self, fn) -> None:
+        """Invoke ``fn(self)`` from the pool's completion thread once this
+        step finishes (or the pool dies — ``result()`` then raises).
+
+        The event-driven alternative to blocking a thread in ``result()``:
+        N concurrent steps need ONE completion thread, not N waiters
+        (reference serves 256 clients on semaphores, src/env.h:46).
+        """
+        self._has_callback = True
+        self._pool._add_done_callback(self._batch_index, fn, self)
 
 
 class EnvPool:
@@ -401,7 +428,8 @@ class EnvPool:
             for slabs in self._layout
         ]
         if self._ctrl is not None:
-            for off in self._ctrl.cmd_sems + self._ctrl.done_sems:
+            for off in (self._ctrl.cmd_sems + self._ctrl.done_sems
+                        + [self._ctrl.notify_sem]):
                 self._native.sem_init(self._shm.buf, off)
             self._rings = []  # cached (slots, tail) views per worker
             for w in range(num_processes):
@@ -439,6 +467,8 @@ class EnvPool:
         self._busy = [False] * num_batches
         self._events: list = [threading.Event() for _ in range(num_batches)]
         self._pending = [0] * num_batches
+        self._callbacks: Dict[int, list] = {}
+        self._notify_thread = None
         self._waiter_error: Optional[str] = None
         self._waiter = None
         if self._ctrl is None:
@@ -508,27 +538,42 @@ class EnvPool:
 
     def _wait_native(self, batch_index: int, timeout: Optional[float]):
         """Wait for all workers' done posts on this buffer, with liveness
-        checks on each poll slice."""
+        checks on each poll slice.
+
+        Shares ``_pending`` (under the lock) with ``_notify_loop``: when a
+        callback registers mid-wait, the notify loop starts consuming the
+        same done semaphores, so this waiter must re-read the shared count
+        each slice and fall back to the completion event once the callback
+        path owns the drain — a stale local count would strand both."""
         deadline = None if timeout is None else time.monotonic() + timeout
         off = self._ctrl.done_sems[batch_index]
-        remaining = self._pending[batch_index]
-        while remaining > 0:
+        event = self._events[batch_index]
+        while True:
+            with self._lock:
+                if self._pending[batch_index] <= 0:
+                    event.set()
+                    return
+                cb_owned = batch_index in self._callbacks
+            if event.is_set():
+                return  # completed (or pool failed: _collect raises)
             slice_t = 0.5
             if deadline is not None:
                 left = deadline - time.monotonic()
                 if left <= 0:
-                    self._pending[batch_index] = remaining
                     raise TimeoutError("EnvStepperFuture.result timed out")
                 slice_t = min(slice_t, left)
-            if self._native.sem_wait(self._shm.buf, off, slice_t):
-                remaining -= 1
+            if cb_owned:
+                if event.wait(slice_t):
+                    return
+            elif self._native.sem_wait(self._shm.buf, off, slice_t):
+                with self._lock:
+                    self._pending[batch_index] -= 1
                 continue
             self._check_workers_alive()
             if self._closed:
                 raise RuntimeError(
                     "EnvPool was closed with this step in flight"
                 )
-        self._pending[batch_index] = 0
 
     def _check_workers_alive(self):
         for w, p in enumerate(self._procs):
@@ -558,23 +603,105 @@ class EnvPool:
                     except (EOFError, OSError):
                         if not self._closed:
                             self._waiter_error = "worker pipe closed"
-                            for ev in self._events:
-                                ev.set()
+                            self._fail_all_waiters()
                         return
                     if kind == "error":
                         self._waiter_error = payload
-                        for ev in self._events:
-                            ev.set()
+                        self._fail_all_waiters()
                         return
                     assert kind == "done"
+                    fired = None
                     with self._lock:
                         self._pending[payload] -= 1
                         if self._pending[payload] == 0:
                             self._events[payload].set()
+                            fired = self._callbacks.pop(payload, None)
+                    if fired:
+                        self._run_callbacks(fired)
         except Exception as e:
             self._waiter_error = f"{type(e).__name__}: {e}"
-            for ev in self._events:
-                ev.set()
+            self._fail_all_waiters()
+
+    # -- async completion (callback path) ------------------------------------
+
+    def _add_done_callback(self, batch_index: int, fn, fut):
+        fire_now = False
+        with self._lock:
+            if self._waiter_error or self._closed:
+                fire_now = True
+            elif not self._busy[batch_index]:
+                fire_now = True  # step already collected
+            elif self._ctrl is None and self._events[batch_index].is_set():
+                fire_now = True  # pipe mode: completed, not yet collected
+            else:
+                self._callbacks.setdefault(batch_index, []).append((fn, fut))
+                if self._ctrl is not None and self._notify_thread is None:
+                    # Open the workers' notify gate BEFORE draining starts:
+                    # in-flight steps dispatched before this post their
+                    # done-sems regardless, and the registration-race post
+                    # below forces a first scan.
+                    self._ctrl.flag_view(self._shm.buf)[0] = 1
+                    self._notify_thread = threading.Thread(
+                        target=self._notify_loop, daemon=True,
+                        name="envpool-notify",
+                    )
+                    self._notify_thread.start()
+        if fire_now:
+            self._run_callbacks([(fn, fut)])
+        elif self._ctrl is not None:
+            # Completion may have raced registration (all done-sems consumed
+            # by an earlier scan): force one fresh scan.
+            self._native.sem_post(self._shm.buf, self._ctrl.notify_sem)
+
+    def _notify_loop(self):
+        """Single event-driven completion thread for ALL buffers: blocks on
+        the control block's notify semaphore (posted by every worker after
+        every step slice), attributes completions via non-blocking drains of
+        the per-buffer done semaphores, and fires callbacks
+        (reference: one semaphore-driven server serves 256 clients,
+        src/env.h:46)."""
+        native, ctrl = self._native, self._ctrl
+        try:
+            while not self._closed:
+                woke = native.sem_wait(self._shm.buf, ctrl.notify_sem, 0.5)
+                fired = []
+                with self._lock:
+                    for b in list(self._callbacks):
+                        while self._pending[b] > 0 and native.sem_wait(
+                            self._shm.buf, ctrl.done_sems[b], 0.0
+                        ):
+                            self._pending[b] -= 1
+                        if self._pending[b] == 0:
+                            self._events[b].set()
+                            fired.extend(self._callbacks.pop(b))
+                if fired:
+                    self._run_callbacks(fired)
+                elif not woke and not self._closed:
+                    try:
+                        self._check_workers_alive()
+                    except RuntimeError:
+                        self._fail_all_waiters()
+                        return
+        except Exception as e:
+            self._waiter_error = f"{type(e).__name__}: {e}"
+            self._fail_all_waiters()
+
+    def _run_callbacks(self, items):
+        for fn, fut in items:
+            try:
+                fn(fut)
+            except Exception as e:
+                log.error("env step callback failed: %s", e)
+
+    def _fail_all_waiters(self):
+        """Worker death / close: wake every blocked result() and fire every
+        registered callback (whose result() will raise the recorded error)."""
+        for ev in self._events:
+            ev.set()
+        with self._lock:
+            pending = [cb for cbs in self._callbacks.values() for cb in cbs]
+            self._callbacks.clear()
+        self._run_callbacks(pending)
 
     def _collect(self, batch_index: int):
         if self._waiter_error:
@@ -605,10 +732,18 @@ class EnvPool:
             return
         self._closed = True
         # Unblock any future whose step was in flight: its result() will see
-        # the closed pool and raise instead of hanging forever.
-        for ev in self._events:
-            ev.set()
+        # the closed pool and raise instead of hanging forever. Registered
+        # callbacks fire now for the same reason.
+        self._fail_all_waiters()
         if self._ctrl is not None:
+            # Wake the notify loop so it observes _closed and exits.
+            if self._notify_thread is not None:
+                try:
+                    self._native.sem_post(
+                        self._shm.buf, self._ctrl.notify_sem
+                    )
+                except Exception:
+                    pass
             for w in range(self.num_processes):
                 try:
                     self._push_cmd(w, _CMD_CLOSE)

@@ -48,10 +48,12 @@ class EnvPoolServer:
       - ``info()`` -> {batch_size, num_batches, action_shape, action_dtype}
       - ``acquire(client)`` -> dedicated batch index for that client
       - ``release(batch_index)`` -> return a buffer to the free list
-      - ``step(batch_index, action, client)`` -> step-result dict (blocks
-        the serving thread until the workers finish — callers overlap by
-        using distinct buffers, so ``num_batches`` steps proceed
-        concurrently)
+      - ``step(batch_index, action, client)`` -> step-result dict. Served
+        as a DEFERRED return: the handler dispatches into the pool and
+        replies from the pool's completion thread, so N concurrent clients
+        occupy zero executor threads while their envs step (the reference
+        serves 256 clients on semaphores, src/env.h:46 — not on a
+        thread-per-step)
 
     A dead client's buffer is reclaimed by lease expiry: a buffer whose
     owner hasn't stepped for ``lease_timeout`` seconds may be handed to a
@@ -69,10 +71,11 @@ class EnvPoolServer:
         self._free = list(range(pool.num_batches))
         self._owners: dict = {}
         self._last_step: dict = {}
+        self._inflight: dict = {}  # batch_index -> EnvStepperFuture
         rpc.define(f"{name}::info", self._info)
         rpc.define(f"{name}::acquire", self._acquire)
         rpc.define(f"{name}::release", self._release)
-        rpc.define(f"{name}::step", self._step)
+        rpc.define_deferred(f"{name}::step", self._step)
 
     def _info(self):
         action = self.pool._views[0]["action"]
@@ -122,32 +125,35 @@ class EnvPoolServer:
                 # belongs to someone else now — do not free it under them.
                 return False
             del self._owners[batch_index]
-        if self.pool.busy(batch_index):
-            # The closing client still has a step executing (its ::step
-            # handler is blocked in the pool); freeing the buffer now would
-            # hand the next client a busy buffer. Defer until it drains.
-            threading.Thread(
-                target=self._free_when_idle, args=(batch_index,), daemon=True
-            ).start()
-        else:
-            with self._lock:
+        # Decide under the same lock that _step dispatches under: busy=True
+        # implies _inflight holds the CURRENT step's future (dispatch and
+        # bookkeeping are atomic in _step), so the busy-with-stale-future
+        # and busy-with-no-future races cannot occur.
+        with self._lock:
+            busy = self.pool.busy(batch_index)
+            inflight = self._inflight.get(batch_index) if busy else None
+            if not busy:
                 self._free.append(batch_index)
+                return True
+        # The closing client still has a step executing; freeing the buffer
+        # now would hand the next client a busy buffer. Free it from the
+        # pool's completion callback instead of polling.
+
+        def free_after(_fut):
+            with self._lock:
+                if not self.pool.busy(batch_index):
+                    self._free.append(batch_index)
+                else:
+                    log.warning(
+                        "env buffer %d still busy after release; leaked",
+                        batch_index,
+                    )
+
+        inflight.add_done_callback(free_after)
         return True
 
-    def _free_when_idle(self, batch_index: int, timeout: float = 120.0):
-        deadline = time.monotonic() + timeout
-        while self.pool.busy(batch_index) and time.monotonic() < deadline:
-            time.sleep(0.01)
-        with self._lock:
-            if not self.pool.busy(batch_index):
-                self._free.append(batch_index)
-            else:
-                log.warning(
-                    "env buffer %d stuck busy after release; leaked",
-                    batch_index,
-                )
-
-    def _step(self, batch_index: int, action, client: Optional[str] = None):
+    def _step(self, deferred, batch_index: int, action,
+              client: Optional[str] = None):
         # Ownership check: a stale step racing a release/re-acquire must
         # never touch a buffer that now belongs to someone else.
         with self._lock:
@@ -158,9 +164,22 @@ class EnvPoolServer:
                     f"(owner: {owner!r}); re-acquire before stepping"
                 )
             self._last_step[batch_index] = time.monotonic()
-        # Runs on the rpc executor; blocking here is the backpressure the
-        # client's Future surfaces. Distinct buffers run concurrently.
-        return self.pool.step(batch_index, np.asarray(action)).result()
+            # Dispatch + bookkeeping atomically: _release's busy check under
+            # this lock must always see the future belonging to the current
+            # in-flight step (never busy-without-future or a stale one).
+            fut = self.pool.step(batch_index, np.asarray(action))
+            self._inflight[batch_index] = fut
+
+        # Reply from the pool's completion thread: no serving thread is
+        # held while the workers step (the backpressure the old blocking
+        # handler provided comes from the deferred reply instead).
+        def on_done(f):
+            try:
+                deferred(f.result(timeout=0))
+            except Exception as e:
+                deferred.error(f"{type(e).__name__}: {e}")
+
+        fut.add_done_callback(on_done)
 
     def close(self):
         for fn in ("info", "acquire", "release", "step"):

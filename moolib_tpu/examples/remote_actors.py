@@ -155,9 +155,11 @@ def run_learner(cfg: RemoteConfig, listen: str = "127.0.0.1:0",
             # Backpressure: delay the ack while the learner lags — each
             # actor keeps only one un-acked ship in flight, so holding the
             # ack here bounds the Batcher backlog instead of growing it
-            # without limit.
-            while batcher.ready() >= 8 and not stop.is_set():
-                time.sleep(0.01)
+            # without limit. wait_below wakes on actual consumption; the
+            # timeout only bounds shutdown latency.
+            while not batcher.wait_below(8, timeout=0.5):
+                if stop.is_set():
+                    break
             batcher.cat(args[0])
             return_cb(True)
 
@@ -180,10 +182,12 @@ def run_learner(cfg: RemoteConfig, listen: str = "127.0.0.1:0",
         while updates < cfg.total_updates and (
             cfg.max_seconds is None or time.monotonic() - t0 < cfg.max_seconds
         ):
-            if batcher.empty():
-                time.sleep(0.002)
+            try:
+                # Blocking get with a short timeout (re-checks the stop and
+                # deadline conditions) instead of an empty()+sleep poll.
+                batch = batcher.get(timeout=0.1)
+            except TimeoutError:
                 continue
-            batch = batcher.get()
             batch = {
                 k: jax.tree_util.tree_map(jnp.asarray, v)
                 for k, v in batch.items()

@@ -170,7 +170,22 @@ class Batcher:
                 raise TimeoutError("Batcher.get timed out")
             if not (self._ready and self._ready[0].done):
                 raise RuntimeError("Batcher is closed")
-            return self._ready.popleft().batch
+            batch = self._ready.popleft().batch
+            # Wake producers parked in wait_below (backpressure release).
+            self._lock.notify_all()
+            return batch
+
+    def wait_below(self, n: int, timeout: Optional[float] = None) -> bool:
+        """Block until fewer than ``n`` completed batches are queued (or the
+        batcher closes). The event-driven producer-side backpressure
+        primitive: wakes on actual consumption instead of polling
+        ``ready()`` in a sleep loop. Returns False on timeout."""
+        with self._lock:
+            return self._lock.wait_for(
+                lambda: self._closed
+                or sum(1 for s in self._ready if s.done) < n,
+                timeout=timeout,
+            )
 
     def close(self) -> None:
         with self._lock:

@@ -61,8 +61,11 @@ REDUCE_OPS: Dict[str, Callable] = {
 # executor threads, where the unchunked path serializes full-buffer
 # transfer -> add -> transfer per tree level.
 _ELEMENTWISE = frozenset({_sum, _prod, _min, _max})
+# 4MB default: measured on the loopback tree bench, per-message overhead
+# dominates below ~2MB chunks and pipelining gains flatten above ~4MB
+# (tools/allreduce_decomp.py records the sweep).
 _CHUNK_BYTES = int(__import__("os").environ.get(
-    "MOOLIB_TPU_ALLREDUCE_CHUNK", 1 << 20
+    "MOOLIB_TPU_ALLREDUCE_CHUNK", 1 << 22
 ))
 _CHUNK_THRESHOLD = 2 * _CHUNK_BYTES if _CHUNK_BYTES else (1 << 62)
 
@@ -77,7 +80,8 @@ class AllReduce(Future):
 
 class _Op:
     __slots__ = ("key", "data", "op_fn", "children", "received",
-                 "future", "started", "index", "members", "forwarded")
+                 "future", "started", "index", "members", "forwarded",
+                 "owns", "lock")
 
     def __init__(self, key, data, op_fn, index, members, future):
         self.key = key
@@ -93,6 +97,10 @@ class _Op:
         self.future = future
         self.started = time.monotonic()
         self.forwarded = False
+        # data starts as the CALLER's arrays (never mutated); after the
+        # first merge it is op-private and later merges may go in-place.
+        self.owns = False
+        self.lock = threading.Lock()  # serializes merges of this op
 
 
 class Group:
@@ -126,9 +134,15 @@ class Group:
     class _Shared:
         def __init__(self, rpc: Rpc):
             self.groups: Dict[str, "Group"] = {}
-            rpc.define("GroupService::update", self._on_update)
-            rpc.define("AllReduceService::reduce", self._on_reduce)
-            rpc.define("AllReduceService::share", self._on_share)
+            # inline=True: the tree's per-hop cost is dominated by thread
+            # handoffs at high chunk rates; these handlers are short (a
+            # chunk-sized elementwise reduce at most) and never block. Heavy
+            # completion work (pytree reassembly) is explicitly offloaded —
+            # see _completion_executor.
+            rpc.define("GroupService::update", self._on_update, inline=True)
+            rpc.define("AllReduceService::reduce", self._on_reduce,
+                       inline=True)
+            rpc.define("AllReduceService::share", self._on_share, inline=True)
 
         def register(self, group: "Group"):
             self.groups[group.group_name] = group
@@ -219,10 +233,15 @@ class Group:
             if old is not None:
                 for key in [k for k in self._parked if _is_current(k, old)]:
                     del self._parked[key]
-        for op in cancelled:
-            op.future._set_exception(
-                RpcError(f"allreduce {op.key} cancelled: membership changed")
-            )
+        if cancelled:
+            pool = _completion_executor()
+            for op in cancelled:
+                pool.submit(
+                    op.future._set_exception,
+                    RpcError(
+                        f"allreduce {op.key} cancelled: membership changed"
+                    ),
+                )
         if old is not None:
             log.info("group %s: resync %s -> %s (%d members)",
                      self.group_name, old[:8], sync_id[:8], len(members))
@@ -241,10 +260,13 @@ class Group:
                 ]
                 if not self._parked[key]:
                     del self._parked[key]
-        for op in expired:
-            op.future._set_exception(
-                RpcError(f"allreduce {op.key} timed out")
-            )
+        if expired:
+            pool = _completion_executor()
+            for op in expired:
+                pool.submit(
+                    op.future._set_exception,
+                    RpcError(f"allreduce {op.key} timed out"),
+                )
 
     # -- allreduce -----------------------------------------------------------
 
@@ -324,6 +346,7 @@ class Group:
         results: List[Any] = [None] * len(groups)
         remaining = [len(groups)]
         done_lock = threading.Lock()
+        reassembler = _completion_executor()
 
         def reassemble():
             per_leaf: Dict[int, List[np.ndarray]] = {}
@@ -349,10 +372,15 @@ class Group:
                     remaining[0] -= 1
                     last = remaining[0] == 0
                 if last:
-                    try:
-                        parent._set_result(reassemble())
-                    except Exception as e:  # defensive: shape mismatch
-                        parent._set_exception(e)
+                    # Sub-op futures complete on the RPC IO thread (inline
+                    # share handler); the multi-MB concatenate must not run
+                    # there, so reassembly gets its own thread.
+                    def finish():
+                        try:
+                            parent._set_result(reassemble())
+                        except Exception as e:  # defensive: shape mismatch
+                            parent._set_exception(e)
+                    reassembler.submit(finish)
             return cb
 
         subs = []
@@ -378,8 +406,36 @@ class Group:
                     (op_key, payload, time.monotonic())
                 )
                 return
-            op.data = _apply(op.op_fn, op.data, payload)
-            op.received += 1
+        if op.op_fn not in _ELEMENTWISE:
+            # Custom ops (e.g. the Accumulator's gradient-bundle merge) can
+            # be arbitrarily heavy and must not run on the inline RPC IO
+            # thread. The completion pool is multi-threaded; per-op merge
+            # ordering is guaranteed by op.lock in _merge_and_forward, NOT
+            # by pool width.
+            _completion_executor().submit(self._merge_and_forward, op, payload)
+            return
+        self._merge_and_forward(op, payload)
+
+    def _merge_and_forward(self, op: "_Op", payload):
+        # The heavy merge runs OUTSIDE the group-wide lock (inline handlers
+        # on the RPC IO thread contend on it for every message); op.lock
+        # serializes merges of this op only. In-place mutation of op.data
+        # off the global lock is safe: merges are the only writers (op.lock
+        # serialized) and _maybe_forward only forwards after the last merge.
+        with op.lock:
+            with self._lock:
+                if self._active.get(op.key) is not op:
+                    return  # cancelled/expired while queued
+                data, owns = op.data, op.owns
+            if not (owns and _apply_inplace(op.op_fn, data, payload)):
+                data = _apply(op.op_fn, data, payload)
+                owns = op.op_fn in _ELEMENTWISE
+            with self._lock:
+                if self._active.get(op.key) is not op:
+                    return
+                op.data = data
+                op.owns = owns
+                op.received += 1
         self._maybe_forward(op)
 
     def _maybe_forward(self, op: _Op):
@@ -417,7 +473,10 @@ class Group:
                 child, "AllReduceService::share",
                 _log_err(f"share->{child}"), op_key, result,
             )
-        op.future._set_result(result)
+        # Service handlers run inline on the RPC IO thread; user
+        # done-callbacks (e.g. Accumulator gradient commits) must not — a
+        # blocked callback would stall every connection on this Rpc.
+        _completion_executor().submit(op.future._set_result, result)
 
     def close(self):
         shared = getattr(self.rpc, "_moolib_group_shared", None)
@@ -426,6 +485,31 @@ class Group:
 
 
 # -- helpers ----------------------------------------------------------------
+
+
+_completion_pool = None
+_completion_pool_lock = threading.Lock()
+
+
+def _completion_executor():
+    """Shared executor for allreduce future completions, custom-op merges,
+    and chunk reassembly.
+
+    Deliberately NOT the Rpc function executor (user handlers may block on
+    allreduce futures from those threads) and deliberately more than one
+    thread: a done-callback that synchronously waits on ONE other collective
+    still makes progress. Contract (same as the reference's scheduler
+    callbacks): done-callbacks must not block indefinitely — a callback
+    chain deeper than the pool width can still starve itself."""
+    global _completion_pool
+    with _completion_pool_lock:
+        if _completion_pool is None:
+            import concurrent.futures
+
+            _completion_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="allreduce-complete"
+            )
+        return _completion_pool
 
 
 def _resolve_op(op) -> Callable:
@@ -443,6 +527,34 @@ def _apply(op_fn, a, b):
     if op_fn in (_sum, _prod, _min, _max):
         return nest.map_structure(op_fn, a, b)
     return op_fn(a, b)
+
+
+_INPLACE_UFUNC = {_sum: np.add, _prod: np.multiply,
+                  _min: np.minimum, _max: np.maximum}
+
+
+def _apply_inplace(op_fn, a, b) -> bool:
+    """Leaf-wise ``ufunc(a, b, out=a)`` merge, skipping an allocation (and
+    its page-fault pass) per interior-node merge. Only attempted when every
+    ``a`` leaf is an op-owned writable array matching its ``b`` leaf in
+    shape and dtype; returns False untouched otherwise so the caller falls
+    back to the allocating path."""
+    ufunc = _INPLACE_UFUNC.get(op_fn)
+    if ufunc is None:
+        return False
+    la, lb = nest.flatten(a), nest.flatten(b)
+    if len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        if not (
+            isinstance(x, np.ndarray) and x.ndim and x.flags.writeable
+            and isinstance(y, np.ndarray) and x.shape == y.shape
+            and x.dtype == y.dtype
+        ):
+            return False
+    for x, y in zip(la, lb):
+        ufunc(x, y, out=x)
+    return True
 
 
 def _group_of(op_key: str) -> str:

@@ -49,15 +49,24 @@ def lstm_flops(d_in: int, hidden: int) -> int:
     return 2 * 4 * hidden * (d_in + hidden)
 
 
+# ImpalaNet architecture defaults — the single source shared by
+# impala_layer_walk and impala_forward_flops so the two signatures cannot
+# drift (models/impala.py mirrors these).
+_IMPALA_DEFAULTS = dict(
+    height=84, width=84, in_channels=4, channels=(16, 32, 32),
+    hidden_size=256, num_actions=6, use_lstm=False, lstm_size=256,
+)
+
+
 def impala_layer_walk(
-    height: int = 84,
-    width: int = 84,
-    in_channels: int = 4,
-    channels: Sequence[int] = (16, 32, 32),
-    hidden_size: int = 256,
-    num_actions: int = 6,
-    use_lstm: bool = False,
-    lstm_size: int = 256,
+    height: int = _IMPALA_DEFAULTS["height"],
+    width: int = _IMPALA_DEFAULTS["width"],
+    in_channels: int = _IMPALA_DEFAULTS["in_channels"],
+    channels: Sequence[int] = _IMPALA_DEFAULTS["channels"],
+    hidden_size: int = _IMPALA_DEFAULTS["hidden_size"],
+    num_actions: int = _IMPALA_DEFAULTS["num_actions"],
+    use_lstm: bool = _IMPALA_DEFAULTS["use_lstm"],
+    lstm_size: int = _IMPALA_DEFAULTS["lstm_size"],
 ):
     """Yield per-layer records for ImpalaNet (models/impala.py):
     ``(name, flops_per_frame, contraction_k, output_lanes_n, out_elems)``.
@@ -98,9 +107,30 @@ def impala_layer_walk(
            dense_flops(hidden_size, 1), hidden_size, 1, 1)
 
 
-def impala_forward_flops(**kw) -> int:
+def impala_forward_flops(
+    height: int = _IMPALA_DEFAULTS["height"],
+    width: int = _IMPALA_DEFAULTS["width"],
+    in_channels: int = _IMPALA_DEFAULTS["in_channels"],
+    channels: Sequence[int] = _IMPALA_DEFAULTS["channels"],
+    hidden_size: int = _IMPALA_DEFAULTS["hidden_size"],
+    num_actions: int = _IMPALA_DEFAULTS["num_actions"],
+    use_lstm: bool = _IMPALA_DEFAULTS["use_lstm"],
+    lstm_size: int = _IMPALA_DEFAULTS["lstm_size"],
+) -> int:
     """Forward FLOPs per frame for ImpalaNet — sum of the layer walk."""
-    return sum(rec[1] for rec in impala_layer_walk(**kw))
+    return sum(
+        rec[1]
+        for rec in impala_layer_walk(
+            height=height,
+            width=width,
+            in_channels=in_channels,
+            channels=channels,
+            hidden_size=hidden_size,
+            num_actions=num_actions,
+            use_lstm=use_lstm,
+            lstm_size=lstm_size,
+        )
+    )
 
 
 def impala_train_flops(frames: int, **kw) -> int:

@@ -45,3 +45,16 @@ class DictObsEnv(FakeEnv):
 class BadEnv:
     def __init__(self, seed: int):
         raise RuntimeError("boom at construction")
+
+
+class SlowEnv(FakeEnv):
+    """FakeEnv with a fixed per-step delay — for asserting that serving N
+    in-flight steps holds no executor threads (async stepper tests)."""
+
+    STEP_SECONDS = 0.15
+
+    def step(self, action):
+        import time
+
+        time.sleep(self.STEP_SECONDS)
+        return super().step(action)

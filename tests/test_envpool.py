@@ -1,3 +1,5 @@
+import threading
+
 import numpy as np
 import pytest
 
@@ -134,3 +136,29 @@ def test_push_cmd_ring_wraparound():
         push(fake, 0, 0)
     with pytest.raises(RuntimeError, match="overflow"):
         push(fake, 0, 0)
+
+
+def test_notify_gate_stays_closed_without_callbacks():
+    """Blocking-only pools must never accumulate notify-semaphore posts:
+    workers gate their notify post on the shm flag, which only opens when a
+    done-callback starts the drain thread (an ungated post per step would
+    hit SEM_VALUE_MAX after ~2^31 steps and crash the worker)."""
+    from fake_env import FakeEnv
+
+    pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=2)
+    try:
+        if pool._ctrl is None:
+            pytest.skip("native data plane unavailable (pipe mode)")
+        flag = pool._ctrl.flag_view(pool._shm.buf)
+        for _ in range(3):
+            pool.step(0, np.zeros(4, np.int64)).result(timeout=30)
+        assert flag[0] == 0  # gate closed: nothing registered a callback
+
+        done = threading.Event()
+        fut = pool.step(0, np.zeros(4, np.int64))
+        fut.add_done_callback(lambda f: done.set())
+        assert flag[0] == 1  # gate opened with the first callback
+        assert done.wait(30)
+        fut.result(timeout=0)
+    finally:
+        pool.close()

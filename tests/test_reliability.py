@@ -131,6 +131,7 @@ def test_poke_nack_resends_lost_request():
         assert client.sync("host", "inc", 1) == 2  # connection established
 
         real_write = client._write
+        real_write_now = client._write_now
         dropped = []
 
         async def lossy_write(conn, frames):
@@ -141,6 +142,9 @@ def test_poke_nack_resends_lost_request():
             await real_write(conn, frames)
 
         client._write = lossy_write
+        # Disable the synchronous fast path so every send flows through the
+        # loss-injectable awaitable seam.
+        client._write_now = lambda conn, frames: False
         t0 = time.monotonic()
         fut = client.async_("host", "inc", 41)
         assert fut.result(timeout=10) == 42
@@ -150,6 +154,7 @@ def test_poke_nack_resends_lost_request():
         assert calls == [1, 41]  # no duplicate execution
     finally:
         client._write = real_write
+        client._write_now = real_write_now
         client.close()
         host.close()
 

@@ -60,9 +60,11 @@ def main():
 
     train_f = TRAIN_FLOPS_MULTIPLIER * frames * tot_f
     naive_ceiling = tot_f / tot_padded
-    # fwd writes each activation once; bwd re-reads it and writes a grad of
-    # the same shape -> ~3x fwd activation traffic is the usual floor.
-    traffic = 3 * frames * act_bytes
+    # fwd reads each layer's input (~= previous layer's output) and writes
+    # its activation; bwd re-reads the activation and writes a grad of the
+    # same shape -> ~4x fwd activation bytes. Weight/grad-weight traffic is
+    # omitted (params are ~1.6MB total, noise next to activations here).
+    traffic = 4 * frames * act_bytes
     print(f"\nper-frame useful fwd FLOPs:    {tot_f / 1e6:.1f} M")
     print(f"train step ({frames} frames):  {train_f / 1e12:.2f} TFLOP useful")
     print(f"naive-mapping MXU ceiling:     {naive_ceiling:.1%} MFU "
@@ -70,8 +72,10 @@ def main():
           " TFLOP-equiv)")
     print(f"MXU time floor @197T bf16:     {train_f / PEAK * 1e3:.1f} ms "
           f"(100% MFU), {train_f / PEAK / naive_ceiling * 1e3:.1f} ms naive")
-    print(f"activation traffic (~3x fwd):  {traffic / 1e9:.1f} GB "
-          f"-> HBM floor {traffic / HBM * 1e3:.1f} ms @819GB/s")
+    print(f"activation traffic (~4x fwd):  {traffic / 1e9:.1f} GB "
+          f"-> HBM floor {traffic / HBM * 1e3:.1f} ms @819GB/s "
+          "(input reads + act writes + bwd re-reads + grad writes; "
+          "weight traffic omitted)")
     if (B, T) == (256, 20):
         print(f"\nreading: measured {MEASURED_MS_B256:.0f} ms/step "
               "(PERF_r03.json, B=256) sits between the naive-mapping MXU "
