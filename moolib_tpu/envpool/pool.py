@@ -758,11 +758,25 @@ class EnvPool:
         for p in self._procs:
             p.join(timeout=5)
         self._terminate()
+        # The notify loop's native sem_wait exports a Py_buffer over
+        # shm.buf for up to its 0.5s slice; releasing the segment with the
+        # export live raises BufferError — join the thread first.
+        if self._notify_thread is not None:
+            self._notify_thread.join(timeout=2.0)
         try:
             self._shm.close()
             self._shm.unlink()
         except FileNotFoundError:
             pass
+        except BufferError:
+            # A wedged callback kept the notify loop's buffer export alive
+            # past the join timeout; leak the mapping rather than crash
+            # teardown (the process exit reclaims it).
+            log.warning("shm release deferred: notify loop still active")
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
 
     def _terminate(self):
         for p in self._procs:

@@ -9,9 +9,16 @@ from .mesh import (
     replicated_spec,
     shard_batch,
 )
-from .moe import moe_ffn, moe_params
-from .pipeline import pipeline_apply, stack_stage_params
+from .moe import moe_ffn, moe_ffn_sharded, moe_params
+from .pipeline import (
+    MICRO_SPEC,
+    pipeline_apply,
+    shard_microbatches,
+    stack_stage_params,
+    unshard_microbatches,
+)
 from .tp import (
+    count_sharded_leaves,
     impala_tp_specs,
     shard_params,
     sharded_init_opt_state,
@@ -28,12 +35,17 @@ __all__ = [
     "pmean_gradients",
     "dp_average_grads",
     "shard_batch",
+    "count_sharded_leaves",
     "impala_tp_specs",
     "shard_params",
     "sharded_init_opt_state",
     "transformer_tp_specs",
     "moe_ffn",
+    "moe_ffn_sharded",
     "moe_params",
+    "MICRO_SPEC",
     "pipeline_apply",
+    "shard_microbatches",
     "stack_stage_params",
+    "unshard_microbatches",
 ]

@@ -1,14 +1,32 @@
 """Pipeline parallelism: GPipe-style microbatch pipelining over a ``pp``
-mesh axis.
+mesh axis, with microbatches SHARDED over the pipeline.
 
 The reference has no pipeline parallelism (its only strategy is elastic DP,
 SURVEY.md §2.5) — this is TPU-first scope completing the mesh-axis
 portfolio (dp/tp/sp/pp/ep). The construction is the classic JAX SPMD
 pipeline: every device holds ONE stage's parameters; microbatches enter at
 stage 0, activations hop stage-to-stage with ``lax.ppermute`` inside a
-``lax.scan`` over ``n_micro + n_stages - 1`` ticks (the bubble), and the
-last stage collects outputs. All devices execute the same program — stage
-identity is data (``axis_index``), exactly how XLA wants SPMD control flow.
+``lax.scan`` over ``n_micro + n_stages - 1`` ticks (the fill/drain bubble),
+and the last stage collects outputs. All devices execute the same program —
+stage identity is data (``axis_index``), exactly how XLA wants SPMD control
+flow.
+
+Memory design (the part that matters at scale): inputs and outputs are
+sharded ``1/pp`` per device in a round-robin layout and ROTATE around the
+pipeline ring one hop per tick, so stage 0 always holds the next microbatch
+to feed and the last stage always holds the buffer slot the emerging output
+belongs to. Per-device activation memory is O(n_micro/pp + 1), not
+O(n_micro): no device ever materializes the full microbatch stream, and no
+full-size psum broadcast happens at the end (a single cyclic ppermute
+aligns the output shards).
+
+Why round-robin works: with microbatch ``m`` initially resident on device
+``m % pp`` at local slot ``m // pp`` and the input buffer rotating
+``d -> d-1`` every tick, device 0 at tick ``t`` holds exactly microbatch
+``t`` at slot ``t // pp``. Outputs written on the last stage at slot
+``pos // pp`` plus the same rotation land (after one reverse ppermute) on
+device ``pos % pp`` at slot ``pos // pp`` — the same layout as the inputs.
+Both need ``pp | n_micro`` (enforced by :func:`shard_microbatches`).
 
 Differentiability is free: scan + ppermute transpose cleanly, so the
 backward pass is the reverse pipeline (activations flow backward along the
@@ -18,6 +36,17 @@ Constraints (standard for ppermute pipelines): every stage maps activations
 of one shape to the SAME shape ([microbatch, features] -> same), and stage
 parameters must be a pytree stacked on a leading stage axis sharded over
 ``pp`` (see :func:`stack_stage_params`).
+
+Usage::
+
+    mesh = make_mesh(pp=4, ...)
+    stacked = stack_stage_params(stages)           # shard P('pp', ...)
+    x_sh = shard_microbatches(x, pp)               # [k, pp, mb, F]
+    y_sh = jax.jit(jax.shard_map(
+        lambda p, x: pipeline_apply(stage_fn, p, x, axis_name="pp"),
+        mesh=mesh, in_specs=(P("pp"), MICRO_SPEC), out_specs=MICRO_SPEC,
+    ))(stacked, x_sh)
+    y = unshard_microbatches(y_sh)                 # [n_micro, mb, F]
 """
 
 from __future__ import annotations
@@ -26,10 +55,21 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from .mesh import pvary_if_needed
 
-__all__ = ["pipeline_apply", "stack_stage_params"]
+__all__ = [
+    "pipeline_apply",
+    "stack_stage_params",
+    "shard_microbatches",
+    "unshard_microbatches",
+    "MICRO_SPEC",
+]
+
+# PartitionSpec for arrays produced by shard_microbatches: [k, pp, mb, ...]
+# with the pipeline axis second.
+MICRO_SPEC = P(None, "pp")
 
 
 def stack_stage_params(param_list) -> Any:
@@ -41,14 +81,34 @@ def stack_stage_params(param_list) -> Any:
     )
 
 
+def shard_microbatches(microbatches: jax.Array, n_stages: int) -> jax.Array:
+    """[n_micro, mb, ...] -> [n_micro//pp, pp, mb, ...] round-robin layout
+    for ``in_specs=MICRO_SPEC``: device d's local slot s holds microbatch
+    ``s * pp + d``."""
+    n_micro = microbatches.shape[0]
+    if n_micro % n_stages:
+        raise ValueError(
+            f"n_micro ({n_micro}) must be divisible by the pipeline size "
+            f"({n_stages}) to shard the microbatch stream"
+        )
+    return microbatches.reshape(
+        (n_micro // n_stages, n_stages) + microbatches.shape[1:]
+    )
+
+
+def unshard_microbatches(sharded: jax.Array) -> jax.Array:
+    """Inverse of :func:`shard_microbatches`."""
+    return sharded.reshape((-1,) + sharded.shape[2:])
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stage_params: Any,
     microbatches: jax.Array,
     axis_name: str = "pp",
 ):
-    """Run ``microbatches`` through the stage pipeline. Call INSIDE
-    shard_map (uses ``axis_index``).
+    """Run the local microbatch shard through the stage pipeline. Call
+    INSIDE shard_map (uses ``axis_index``).
 
     Args:
       stage_fn: ``(params, x_mb) -> y_mb`` for ONE stage; activation shape
@@ -56,49 +116,66 @@ def pipeline_apply(
       stage_params: this device's stage slice — leaves with leading dim 1
         (from a ``P('pp', ...)``-sharded stack built by
         :func:`stack_stage_params`).
-      microbatches: ``[n_micro, mb, ...]`` — identical (replicated) on all
-        pipeline devices.
+      microbatches: ``[k, 1, mb, ...]`` — this device's shard of the
+        round-robin layout built by :func:`shard_microbatches` with
+        ``in_specs=MICRO_SPEC`` (local slot s = microbatch ``s*pp + d``).
 
-    Returns ``[n_micro, mb, ...]`` outputs, replicated across the axis.
+    Returns ``[k, 1, mb, ...]`` output shards in the same layout
+    (``out_specs=MICRO_SPEC``; :func:`unshard_microbatches` restores
+    ``[n_micro, ...]``).
     """
     n_stages = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    n_micro = microbatches.shape[0]
+    # Local shard arrives [k, 1, mb, ...] (the pp axis is sharded away).
+    squeeze = microbatches.shape[1] == 1
+    inp0 = microbatches[:, 0] if squeeze else microbatches
+    k = inp0.shape[0]
+    n_micro = k * n_stages
     params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
-    # Forward-only chain: stage d sends to d+1; stage 0 receives nothing
+    # Activation chain: stage d sends to d+1; stage 0 receives nothing
     # (ppermute delivers zeros to unlisted destinations, which stage 0
-    # ignores — it reads from `microbatches`).
-    perm = [(d, d + 1) for d in range(n_stages - 1)]
+    # ignores — it reads from the input shard).
+    chain = [(d, d + 1) for d in range(n_stages - 1)]
+    # Buffer rotation ring: d -> d-1 brings future input blocks toward
+    # stage 0 (and cycles output buffers past the last stage).
+    ring = [(d, (d - 1) % n_stages) for d in range(n_stages)]
 
     def pv(x):
         return pvary_if_needed(x, axis_name)
 
-    act0 = pv(jnp.zeros_like(microbatches[0]))
-    out0 = pv(jnp.zeros_like(microbatches))
+    act0 = pv(jnp.zeros_like(inp0[0]))
+    out0 = pv(jnp.zeros_like(inp0))
+    inp0 = pv(inp0)
 
     def tick(carry, t):
-        act_in, out = carry
-        # Stage 0 feeds microbatch t (clamped: ticks past n_micro push
-        # bubble garbage that never reaches the output window).
-        mb_t = jax.lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
-        )
+        inp, act_in, out = carry
+        # After t rotations device 0 holds the shard born on device t%pp;
+        # slot t//pp of it is microbatch t (clamped: drain ticks read a
+        # stale slot whose result never reaches the output window).
+        slot = jnp.clip(t // n_stages, 0, k - 1)
+        mb_t = jax.lax.dynamic_index_in_dim(inp, slot, 0, keepdims=False)
         x = jnp.where(idx == 0, mb_t, act_in)
         y = stage_fn(params, x)
-        # Last stage stores microbatch t-(n_stages-1) once it emerges.
+        # Last stage stores microbatch pos = t-(pp-1) once it emerges, at
+        # its round-robin slot; rotation carries it to its home device.
         pos = t - (n_stages - 1)
         store = jnp.logical_and(idx == n_stages - 1, pos >= 0)
+        out_slot = jnp.clip(pos // n_stages, 0, k - 1)
         stored = jax.lax.dynamic_update_index_in_dim(
-            out, y.astype(out.dtype), jnp.clip(pos, 0, n_micro - 1), 0
+            out, y.astype(out.dtype), out_slot, 0
         )
         out = jnp.where(store, stored, out)
-        act_next = jax.lax.ppermute(y, axis_name, perm)
-        return (act_next, out), None
+        act_next = jax.lax.ppermute(y, axis_name, chain)
+        inp = jax.lax.ppermute(inp, axis_name, ring)
+        out = jax.lax.ppermute(out, axis_name, ring)
+        return (inp, act_next, out), None
 
-    (_, out), _ = jax.lax.scan(
-        tick, (act0, out0), jnp.arange(n_micro + n_stages - 1)
+    (_, _, out), _ = jax.lax.scan(
+        tick, (inp0, act0, out0), jnp.arange(n_micro + n_stages - 1)
     )
-    # Replicate the last stage's collected outputs to every pipeline device
-    # (everyone else holds zeros).
-    mask = (idx == n_stages - 1).astype(out.dtype)
-    return jax.lax.psum(out * mask, axis_name)
+    # One reverse hop aligns every output shard with its home device
+    # (device m%pp, slot m//pp — the input layout).
+    out = jax.lax.ppermute(
+        out, axis_name, [(d, (d + 1) % n_stages) for d in range(n_stages)]
+    )
+    return out[:, None] if squeeze else out

@@ -32,6 +32,7 @@ __all__ = [
     "impala_tp_specs",
     "shard_params",
     "sharded_init_opt_state",
+    "count_sharded_leaves",
 ]
 
 # Column-parallel: kernel [in, out] splits the OUTPUT features; its bias
@@ -47,48 +48,181 @@ def _path_names(path) -> list:
 
 
 def transformer_tp_specs(params, axis: str = "tp") -> Any:
-    """PartitionSpec pytree for ``TransformerNet`` params.
+    """PartitionSpec pytree for transformer-shaped params, derived from
+    KERNEL SHAPES and tree structure — not layer names, so renaming a flax
+    module cannot silently flip a placement to replicated (VERDICT r3 #8).
 
-    qkv -> column, attn out -> row, MLP up (``Dense_0`` in ``_Block``) ->
-    column, MLP down (``Dense_1``) -> row; embeddings, norms, heads, and
-    the conv torso replicate.
+    Rules (d_model inferred from the LayerNorm scale widths):
+    - [d_model, k*d_model] kernels (k>1: qkv fusions, MLP up-projections)
+      -> column-parallel, bias sharded with the outputs;
+    - [k*d_model, d_model] kernels (MLP down-projections) -> row-parallel,
+      bias replicated;
+    - square [d_model, d_model] kernels -> row-parallel IFF a same-depth
+      sibling module holds a wide column kernel (the attention
+      out-projection next to its qkv); standalone square kernels
+      replicate;
+    - everything else (embeddings, norms, heads, conv torso) replicates.
+
+    Raises RuntimeError when the tree is transformer-shaped (has
+    LayerNorms) but no column or no row placement was derived — the loud
+    alternative to silently replicating a restructured model.
     """
+    from collections import Counter
+
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    scale_widths = [
+        leaf.shape[-1]
+        for path, leaf in leaves
+        if _path_names(path)[-1] == "scale" and getattr(leaf, "ndim", 0) == 1
+    ]
+    if not scale_widths:
+        raise RuntimeError(
+            "transformer_tp_specs: no LayerNorm scales found to infer "
+            "d_model from — is this a transformer parameter tree?"
+        )
+    d_model = Counter(scale_widths).most_common(1)[0][0]
+
+    # First pass: classify every 2D kernel by shape (+ structure for the
+    # square case); record per-parent placement so biases follow kernels.
+    kernels = [
+        (tuple(_path_names(p)), leaf.shape)
+        for p, leaf in leaves
+        if _path_names(p)[-1] == "kernel" and getattr(leaf, "ndim", 0) == 2
+    ]
+
+    def classify(names, shape):
+        fin, fout = shape
+        if fin == d_model and fout > d_model and fout % d_model == 0:
+            return "col"
+        if fin > d_model and fout == d_model and fin % d_model == 0:
+            return "row"  # MLP down-projection (fin strictly > d_model)
+        if fin == d_model and fout == d_model:
+            # Square: row-parallel only next to a wide sibling (attention
+            # out beside its qkv), at the same tree depth.
+            prefix, depth = names[:-2], len(names)
+            for other, oshape in kernels:
+                if (
+                    other != names
+                    and len(other) == depth
+                    and other[:-2] == prefix
+                    and oshape[0] == d_model
+                    and oshape[1] >= 2 * d_model
+                ):
+                    return "row"
+        return None
+
+    candidates = {
+        names[:-1]: kind
+        for names, shape in kernels
+        if (kind := classify(names, shape)) is not None
+    }
+    # Confirm candidates block-wise: a real transformer block contributes a
+    # column/row PAIR under one top-level submodule. A lone wide kernel
+    # (e.g. an action head that happens to be [d_model, 2*d_model]) has no
+    # row partner and must replicate, per the documented head contract.
+    by_block: dict = {}
+    for parent, kind in candidates.items():
+        by_block.setdefault(parent[:2], set()).add(kind)
+    placement = {
+        parent: kind
+        for parent, kind in candidates.items()
+        if by_block[parent[:2]] == {"col", "row"}
+    }
+    n_col = sum(1 for v in placement.values() if v == "col")
+    n_row = sum(1 for v in placement.values() if v == "row")
+    if not n_col or not n_row:
+        raise RuntimeError(
+            f"transformer_tp_specs derived {n_col} column / {n_row} row "
+            f"placements (d_model={d_model}) — the tree has LayerNorms but "
+            "no recognizable qkv/MLP projection shapes; tp would silently "
+            "replicate. Check the model structure or write explicit specs."
+        )
 
     def spec(path, leaf):
-        names = _path_names(path)
-        inside_block = any(n.startswith("block_") for n in names)
-        if "qkv" in names:
-            return _rename(_COL_KERNEL, axis)
-        if "out" in names and names[-1] == "kernel":
-            return _rename(_ROW_KERNEL, axis)
-        if inside_block and "Dense_0" in names:
+        names = tuple(_path_names(path))
+        kind = placement.get(names[:-1])
+        if kind is None:
+            return P()
+        if names[-1] == "kernel":
             return _rename(
-                _COL_KERNEL if names[-1] == "kernel" else _COL_BIAS, axis
+                _COL_KERNEL if kind == "col" else _ROW_KERNEL, axis
             )
-        if inside_block and "Dense_1" in names and names[-1] == "kernel":
-            return _rename(_ROW_KERNEL, axis)
-        return P()
+        if names[-1] == "bias" and kind == "col":
+            return _rename(_COL_BIAS, axis)
+        return P()  # row bias and any other leaf replicate
 
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
 def impala_tp_specs(params, axis: str = "tp") -> Any:
-    """PartitionSpec pytree for ``ImpalaNet`` params: the big flatten->hidden
-    projection (``Dense_0``) is column-parallel, the policy/baseline heads
-    (``Dense_1``/``Dense_2``) row-parallel; convs and LSTM replicate (their
-    channel counts are too small to pay for collectives on TPU)."""
+    """PartitionSpec pytree for ImpalaNet-shaped params, derived from
+    KERNEL SHAPES — not layer names (VERDICT r3 #8).
+
+    The widest-fan-in dense (the conv-flatten -> hidden projection, fan-in
+    an order of magnitude above everything else) is column-parallel; dense
+    kernels reading that hidden width and projecting DOWN (the policy /
+    baseline heads) are row-parallel; convs and LSTM replicate (their
+    channel counts are too small to pay for collectives on TPU).
+
+    Raises RuntimeError when no flatten projection or no heads can be
+    recognized, instead of silently replicating.
+    """
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    dense = [
+        (tuple(_path_names(p)), leaf.shape)
+        for p, leaf in leaves
+        if _path_names(p)[-1] == "kernel" and getattr(leaf, "ndim", 0) == 2
+    ]
+    if not dense:
+        raise RuntimeError(
+            "impala_tp_specs: no 2D dense kernels found in the tree"
+        )
+    flatten_names, flatten_shape = max(dense, key=lambda kv: kv[1][0])
+    hidden = flatten_shape[1]
+    if flatten_shape[0] <= 2 * hidden:
+        raise RuntimeError(
+            "impala_tp_specs: widest dense fan-in "
+            f"{flatten_shape[0]} is not flatten-shaped (hidden={hidden}); "
+            "cannot identify the column-parallel projection — tp would "
+            "silently replicate."
+        )
+    heads = {
+        names[:-1]
+        for names, shape in dense
+        if shape[0] == hidden and shape[1] < hidden
+    }
+    if not heads:
+        raise RuntimeError(
+            f"impala_tp_specs: no head kernels reading hidden={hidden} "
+            "found; row-parallel placement would be empty."
+        )
+
+    col_parent = flatten_names[:-1]
 
     def spec(path, leaf):
-        names = _path_names(path)
-        if "Dense_0" in names:
+        names = tuple(_path_names(path))
+        if names[:-1] == col_parent:
             return _rename(
                 _COL_KERNEL if names[-1] == "kernel" else _COL_BIAS, axis
             )
-        if ("Dense_1" in names or "Dense_2" in names) and names[-1] == "kernel":
+        if names[:-1] in heads and names[-1] == "kernel":
             return _rename(_ROW_KERNEL, axis)
         return P()
 
     return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def count_sharded_leaves(specs) -> int:
+    """Number of leaves with a non-trivial PartitionSpec — callers assert
+    this against the expected count so a model change that stops matching
+    the derivation rules fails loudly instead of silently replicating."""
+    return sum(
+        1
+        for s in jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        if isinstance(x := s, P) and any(e is not None for e in x)
+    )
 
 
 def _rename(spec: P, axis: str) -> P:

@@ -23,6 +23,7 @@ from moolib_tpu.models import ImpalaNet, TransformerNet
 from moolib_tpu.models.transformer import segment_ids_from_done
 from moolib_tpu.parallel.mesh import make_mesh, shard_batch
 from moolib_tpu.parallel.tp import (
+    count_sharded_leaves,
     impala_tp_specs,
     shard_params,
     sharded_init_opt_state,
@@ -59,6 +60,79 @@ def test_transformer_tp_specs_cover_megatron_pattern():
     assert all(flat[k] == P("tp", None) for k in outs + downs)
     # Norms/embeddings replicate.
     assert flat["params/pos_emb/embedding"] == P()
+    # Shape-derived count: per block qkv + MLP-up columns (+ up bias),
+    # out + MLP-down rows -> 5 sharded leaves per block for this model.
+    assert count_sharded_leaves(specs) == 5 * 1  # num_layers=1
+
+
+def test_tp_specs_are_rename_insensitive_and_fail_loudly():
+    """VERDICT r3 #8: placements derive from shapes+structure, so renaming
+    flax modules changes NOTHING; an unrecognizable tree raises instead of
+    silently replicating."""
+    _net, params, _, _ = _transformer_setup()
+    ref_count = count_sharded_leaves(transformer_tp_specs(params))
+    assert ref_count > 0
+
+    # Rename every module the old implementation string-matched on.
+    renamed = jax.tree_util.tree_map(lambda x: x, params)  # deep-ish copy
+    p = dict(renamed["params"])
+    p["encoder_0"] = p.pop("block_0")
+    enc = dict(p["encoder_0"])
+    enc["attention"] = enc.pop("attn")
+    att = dict(enc["attention"])
+    att["fused_qkv"] = att.pop("qkv")
+    att["proj"] = att.pop("out")
+    enc["attention"] = att
+    enc["mlp_in"] = enc.pop("Dense_0")
+    enc["mlp_out"] = enc.pop("Dense_1")
+    p["encoder_0"] = enc
+    renamed = {"params": p}
+    assert count_sharded_leaves(transformer_tp_specs(renamed)) == ref_count
+
+    # A wide ACTION HEAD ([d_model, 2*d_model]) outside any block must
+    # replicate (documented head contract), not become column-parallel.
+    widehead = jax.tree_util.tree_map(lambda x: x, params)
+    wp = dict(widehead["params"])
+    wp["policy"] = {
+        "kernel": jnp.zeros((16, 32)), "bias": jnp.zeros(32)
+    }
+    widehead = {"params": wp}
+    specs_wh = transformer_tp_specs(widehead)
+    assert specs_wh["params"]["policy"]["kernel"] == P()
+    assert count_sharded_leaves(specs_wh) == ref_count
+
+    # A tree with LayerNorms but no projection shapes raises loudly.
+    degenerate = {
+        "params": {
+            "LayerNorm_0": {
+                "scale": jnp.ones(16), "bias": jnp.zeros(16)
+            },
+            "head": {"kernel": jnp.zeros((16, 3)), "bias": jnp.zeros(3)},
+        }
+    }
+    with pytest.raises(RuntimeError, match="replicate"):
+        transformer_tp_specs(degenerate)
+
+    # Impala derivation: rename-insensitive and loud too.
+    net2 = ImpalaNet(num_actions=4)
+    p2 = net2.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((2, 1, 84, 84, 4), jnp.uint8),
+        jnp.zeros((2, 1), bool),
+        (),
+    )
+    ref2 = count_sharded_leaves(impala_tp_specs(p2))
+    assert ref2 == 4  # flatten kernel+bias column, 2 head kernels row
+    pp = dict(p2["params"])
+    pp["torso_proj"] = pp.pop("Dense_0")
+    pp["pi"] = pp.pop("Dense_1")
+    pp["vf"] = pp.pop("Dense_2")
+    assert count_sharded_leaves(impala_tp_specs({"params": pp})) == ref2
+    with pytest.raises(RuntimeError, match="flatten-shaped"):
+        impala_tp_specs(
+            {"params": {"d": {"kernel": jnp.zeros((16, 16)),
+                              "bias": jnp.zeros(16)}}}
+        )
 
 
 def test_transformer_tp2_matches_tp1():

@@ -1,0 +1,88 @@
+"""Record a real learning-curve artifact against the reference's
+integration bar.
+
+VERDICT r3 #9 asked for a recorded curve on a real environment. ALE and
+ProcGen are not installed in this image (ale_py/procgen missing; verified),
+so the runnable real-env config is the CartPole class — exactly the env the
+reference's own integration test trains (reference:
+test/integration/test_a2c.py:16-36 — A2C on CartPole, pass = return > 100
+on >= 50% of the final log windows).
+
+Runs the real A2C example (the same code path `python -m
+moolib_tpu.examples.a2c` uses), records every log row, evaluates the
+reference bar, and writes the JSON artifact.
+
+Usage: python tools/learning_curve.py [--steps 80000] [--json OUT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=80_000)
+    ap.add_argument("--json", default="LEARNING_r04.json")
+    ap.add_argument("--env", default="cartpole")
+    args = ap.parse_args()
+
+    from moolib_tpu.utils import ensure_platforms
+
+    ensure_platforms()
+    from moolib_tpu.examples.a2c import A2CConfig, train
+
+    cfg = A2CConfig(env=args.env, total_steps=args.steps)
+    t0 = time.perf_counter()
+    rows = train(cfg, log_fn=lambda *a, **k: None)
+    wall = time.perf_counter() - t0
+
+    tail = [r["mean_episode_return"] for r in rows[-20:]]
+    bar_hits = sum(r > 100 for r in tail)
+    # An empty window must FAIL — a run too short to log anything has
+    # measured nothing, not passed vacuously.
+    passed = bool(tail) and bar_hits >= len(tail) / 2
+    art = {
+        "round": 4,
+        "cmd": f"python tools/learning_curve.py --steps {args.steps}",
+        "env": args.env,
+        "algo": "A2C (examples/a2c.py)",
+        "total_steps": args.steps,
+        "wall_s": round(wall, 1),
+        "reference_bar": (
+            "return > 100 on >= 50% of final log windows "
+            "(ref test/integration/test_a2c.py:16-36)"
+        ),
+        "final_window_returns": [round(r, 1) for r in tail],
+        "bar_hits": f"{bar_hits}/{len(tail)}",
+        "passed": bool(passed),
+        "curve": [
+            {
+                "env_steps": r["env_steps"],
+                "mean_episode_return": round(r["mean_episode_return"], 2),
+                "entropy": round(r.get("entropy", float("nan")), 4),
+            }
+            for r in rows
+        ],
+        "note": (
+            "ALE/ProcGen are not installed in this build image (ale_py, "
+            "procgen import-checked missing), so benchmark config 2 maps "
+            "to its CartPole-class equivalent — the same env/bar the "
+            "reference's own integration suite trains."
+        ),
+    }
+    with open(args.json, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps({k: art[k] for k in
+                      ("passed", "bar_hits", "total_steps", "wall_s")}))
+    return 0 if passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
