@@ -38,8 +38,9 @@ from flax import linen as nn
 
 from ..ops import attention as attn_ops
 from ..ops import ring_attention as ring_ops
+from ..parallel.moe import moe_ffn
 
-__all__ = ["TransformerNet"]
+__all__ = ["TransformerNet", "moe_aux_losses"]
 
 
 def segment_ids_from_done(done) -> jax.Array:
@@ -89,19 +90,110 @@ class _SelfAttention(nn.Module):
         return nn.Dense(E, use_bias=False, name="out")(o)
 
 
+class _MoEMlp(nn.Module):
+    """Switch/GShard MoE MLP for a transformer block.
+
+    Routing/capacity/losses come from :func:`moolib_tpu.parallel.moe.moe_ffn`;
+    per-call aux (load-balance loss, router z-loss, drop fraction) is sown
+    into the ``intermediates`` collection — train with
+    ``apply(..., mutable=["intermediates"])`` and fold
+    :func:`moe_aux_losses` into the loss so capacity drops are neither
+    silent nor unpenalized. The router param is deliberately NOT named
+    ``kernel`` so tensor-parallel shape derivation (parallel/tp.py) never
+    mistakes it for a projection.
+    """
+
+    num_experts: int
+    mlp_ratio: int
+    top_k: int
+    capacity_factor: float
+
+    @nn.compact
+    def __call__(self, x):  # [T, B, E] -> [T, B, E]
+        T, B, E = x.shape
+        d_hidden = self.mlp_ratio * E
+        init = nn.initializers.lecun_normal()
+        # batch_axis=0: the expert axis is a batch of independent matrices,
+        # not receptive field — without it fan_in becomes E_experts * d_in
+        # and every expert starts sqrt(num_experts)x too small (the
+        # per-expert scaling moe_params uses).
+        expert_init = nn.initializers.lecun_normal(batch_axis=(0,))
+        params = {
+            "router": self.param("router", init, (E, self.num_experts)),
+            "w_up": self.param(
+                "w_up", expert_init, (self.num_experts, E, d_hidden)
+            ),
+            "w_down": self.param(
+                "w_down", expert_init, (self.num_experts, d_hidden, E)
+            ),
+        }
+        y, aux = moe_ffn(
+            params, x.reshape(T * B, E),
+            top_k=self.top_k, capacity_factor=self.capacity_factor,
+        )
+        self.sow("intermediates", "moe_aux", aux)
+        return y.reshape(T, B, E)
+
+
+def moe_aux_losses(intermediates) -> dict:
+    """Aggregate every MoE layer's sown aux from a flax ``intermediates``
+    collection: summed load-balance and router-z losses (add them to the
+    training loss, typically with weights ~1e-2 / ~1e-3) and the mean drop
+    fraction (log it — silent drops are a capacity bug)."""
+    found = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            if "load_balance_loss" in node:
+                found.append(node)
+            else:
+                for v in node.values():
+                    walk(v)
+        elif isinstance(node, (tuple, list)):
+            for v in node:
+                walk(v)
+
+    walk(intermediates)
+    if not found:
+        raise ValueError("no MoE aux entries in intermediates — was the "
+                         "model built with mlp='moe' and applied with "
+                         "mutable=['intermediates']?")
+    n = len(found)
+    return {
+        "load_balance_loss": sum(a["load_balance_loss"] for a in found),
+        "router_z_loss": sum(a["router_z_loss"] for a in found),
+        "drop_fraction": sum(a["drop_fraction"] for a in found) / n,
+        "n_moe_layers": n,
+    }
+
+
 class _Block(nn.Module):
     num_heads: int
     mlp_ratio: int
     backend: str
     ring_axis: str
+    mlp: str = "dense"
+    num_experts: int = 8
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, seg_bt, positions):
+        if self.mlp not in ("dense", "moe"):
+            raise ValueError(
+                f"unknown mlp type {self.mlp!r}; expected 'dense' or 'moe'"
+            )
         h = nn.LayerNorm()(x)
         x = x + _SelfAttention(
             self.num_heads, self.backend, self.ring_axis, name="attn"
         )(h, seg_bt, positions)
         h = nn.LayerNorm()(x)
+        if self.mlp == "moe":
+            x = x + _MoEMlp(
+                self.num_experts, self.mlp_ratio, self.moe_top_k,
+                self.moe_capacity_factor, name="moe",
+            )(h)
+            return x
         h = nn.Dense(self.mlp_ratio * x.shape[-1])(h)
         h = nn.gelu(h)
         x = x + nn.Dense(x.shape[-1])(h)
@@ -120,6 +212,10 @@ class TransformerNet(nn.Module):
     attention_backend: str = "auto"  # dense|blockwise|flash|ring|zigzag|auto
     ring_axis: str = "sp"
     compute_dtype: jnp.dtype = jnp.float32
+    mlp: str = "dense"  # dense | moe (Switch/GShard blocks; see _MoEMlp)
+    num_experts: int = 8
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, obs, done, core_state, segment_ids=None,
@@ -167,7 +263,10 @@ class TransformerNet(nn.Module):
         for i in range(self.num_layers):
             x = _Block(
                 self.num_heads, self.mlp_ratio, self.attention_backend,
-                self.ring_axis, name=f"block_{i}",
+                self.ring_axis, mlp=self.mlp,
+                num_experts=self.num_experts, moe_top_k=self.moe_top_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                name=f"block_{i}",
             )(x, segment_ids, positions)
 
         x = nn.LayerNorm()(x.astype(jnp.float32))

@@ -16,7 +16,23 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def run_config(B: int, dtype: str, iters: int = 10) -> dict:
+def _space_to_depth(obs, s: int):
+    """[..., H, W, C] -> [..., H/s, W/s, C*s*s]: trades spatial resolution
+    for channel depth, multiplying the first conv's MXU contraction K by
+    s^2 (K = 9*C*s*s) — the tile-efficiency lever PERF_ANALYSIS.md names.
+    A LABELED architecture variant, not the headline config."""
+    *lead, H, W, C = obs.shape
+    obs = obs.reshape(*lead, H // s, s, W // s, s, C)
+    ndim = obs.ndim
+    # move the two s axes behind C: [..., H/s, W/s, s, s, C]
+    perm = tuple(range(ndim - 5)) + (
+        ndim - 5, ndim - 3, ndim - 4, ndim - 2, ndim - 1
+    )
+    obs = obs.transpose(perm)
+    return obs.reshape(*lead, H // s, W // s, C * s * s)
+
+
+def run_config(B: int, dtype: str, s2d: int = 1, iters: int = 10) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -30,8 +46,13 @@ def run_config(B: int, dtype: str, iters: int = 10) -> dict:
     cdt = {"bf16": jnp.bfloat16, "f32": jnp.float32}[dtype]
     net = ImpalaNet(num_actions=A, use_lstm=False, compute_dtype=cdt)
     rng = np.random.default_rng(0)
+    obs = rng.integers(0, 255, (T + 1, B, H, W, C), dtype=np.uint8)
+    h, w, c = H, W, C
+    if s2d > 1:
+        obs = _space_to_depth(obs, s2d)
+        h, w, c = H // s2d, W // s2d, C * s2d * s2d
     batch = {
-        "obs": jnp.asarray(rng.integers(0, 255, (T + 1, B, H, W, C), dtype=np.uint8)),
+        "obs": jnp.asarray(obs),
         "done": jnp.asarray(rng.random((T + 1, B)) < 0.02),
         "rewards": jnp.asarray(rng.standard_normal((T + 1, B)), jnp.float32),
         "actions": jnp.asarray(rng.integers(0, A, (T, B)), jnp.int32),
@@ -48,32 +69,49 @@ def run_config(B: int, dtype: str, iters: int = 10) -> dict:
     state, dt, compile_s = time_train_step(step, state, batch, iters=iters)
 
     steps_per_sec = iters * T * B / dt
-    flops_step = impala_train_flops((T + 1) * B, num_actions=A)
+    flops_step = impala_train_flops(
+        (T + 1) * B, height=h, width=w, in_channels=c, num_actions=A
+    )
     achieved = flops_step * iters / dt
     peak = device_peak_flops(jax.devices()[0].device_kind)
     return {
         "B": B,
         "dtype": dtype,
+        "s2d": s2d,
         "env_steps_per_sec": round(steps_per_sec, 1),
         "tflops": round(achieved / 1e12, 2),
         "mfu": round(achieved / peak, 4) if peak else None,
         "compile_s": round(compile_s, 1),
         "timed_s": round(dt, 3),
+        "note": (
+            "space-to-depth variant: different torso geometry, "
+            "NOT the headline architecture" if s2d > 1 else None
+        ),
     }
 
 
 def main():
-    grid = [(256, "bf16"), (512, "bf16"), (1024, "bf16"), (256, "f32")]
+    # Tunnel-flap resilience: probe in subprocesses before touching jax
+    # in-process (a dead tunnel blocks jax.devices() unkillably).
+    from moolib_tpu.utils.benchmark import wait_for_device
+
+    wait_for_device("perf_sweep")
+    grid = [
+        (256, "bf16", 1), (512, "bf16", 1), (1024, "bf16", 1),
+        (256, "f32", 1), (256, "bf16", 2),
+    ]
     if len(sys.argv) > 1:
         grid = []
         for arg in sys.argv[1:]:
             kv = dict(p.split("=") for p in arg.split(","))
-            grid.append((int(kv.get("B", 256)), kv.get("dtype", "bf16")))
-    for B, dtype in grid:
+            grid.append((int(kv.get("B", 256)), kv.get("dtype", "bf16"),
+                         int(kv.get("s2d", 1))))
+    for B, dtype, s2d in grid:
         try:
-            print(json.dumps(run_config(B, dtype)), flush=True)
+            print(json.dumps(run_config(B, dtype, s2d)), flush=True)
         except Exception as e:  # keep sweeping past OOMs
-            print(json.dumps({"B": B, "dtype": dtype, "error": repr(e)}), flush=True)
+            print(json.dumps({"B": B, "dtype": dtype, "s2d": s2d,
+                              "error": repr(e)}), flush=True)
 
 
 if __name__ == "__main__":
