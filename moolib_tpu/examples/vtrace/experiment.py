@@ -73,6 +73,8 @@ class VtraceConfig:
     reward_clip: float = 1.0
     use_lstm: bool = False
     model: str = "auto"  # auto | mlp | resnet | transformer
+    transformer_mlp: str = "dense"  # dense | moe (Switch blocks + aux loss)
+    num_experts: int = 8
     total_steps: int = 500_000
     max_seconds: Optional[float] = None  # wall-clock stop (benchmarks)
     # infra
@@ -126,7 +128,10 @@ def _make_model(cfg: VtraceConfig):
     if model == "mlp":
         return A2CNet(num_actions=num_actions, use_lstm=cfg.use_lstm)
     if model == "transformer":
-        return TransformerNet(num_actions=num_actions, compute_dtype=dtype)
+        return TransformerNet(
+            num_actions=num_actions, compute_dtype=dtype,
+            mlp=cfg.transformer_mlp, num_experts=cfg.num_experts,
+        )
     if model == "nethack":
         return NetHackNet(
             num_actions=num_actions, use_lstm=cfg.use_lstm,
@@ -218,7 +223,20 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
         reward_clip=cfg.reward_clip,
     )
     act = make_act_step(net.apply)
-    grad_step = make_grad_step(net.apply, config=loss_cfg, mesh=mesh)
+    learn_apply = net.apply
+    if getattr(net, "mlp", "dense") == "moe":
+        # MoE models sow per-layer aux (lb/z losses, drop fraction) into
+        # intermediates; the 3-tuple apply convention folds them into the
+        # loss and the training metrics (drops must never be silent).
+        from moolib_tpu.models.transformer import moe_aux_losses
+
+        def learn_apply(params, obs, done, core_state):
+            (out, st), inter = net.apply(
+                params, obs, done, core_state, mutable=["intermediates"]
+            )
+            return out, st, moe_aux_losses(inter)
+
+    grad_step = make_grad_step(learn_apply, config=loss_cfg, mesh=mesh)
     apply_step = make_apply_step(optimizer, donate=False)
 
     # --- elasticity / persistence ------------------------------------------
@@ -277,6 +295,7 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
         entropy=StatMean(),
         grad_norm=StatMean(),
         sps=StatMean(),
+        moe_drop_fraction=StatMean(),
     )
     gsa = GlobalStatsAccumulator(accumulator.group, stats)
     tsv = (
@@ -395,6 +414,12 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                         window["total_loss"] += float(metrics["total_loss"])
                         window["entropy"] += float(metrics["entropy"])
                         window["grad_norm"] += float(metrics["grad_norm"])
+                        if "moe_drop_fraction" in metrics:
+                            # Capacity drops must be visible in the logs,
+                            # not silently eaten by the residual path.
+                            window["moe_drop_fraction"] += float(
+                                metrics["moe_drop_fraction"]
+                            )
                         b = cfg.learn_batch_size
                         grad_sum = jax.tree_util.tree_map(
                             lambda g: np.asarray(g) * b, grads

@@ -57,6 +57,10 @@ class ImpalaConfig:
     lambda_: float = 1.0
     clip_rho_threshold: float = 1.0
     clip_pg_rho_threshold: float = 1.0
+    # MoE aux-loss weights (used when apply_fn returns model aux — the
+    # TransformerNet(mlp='moe') path); Switch/ST-MoE defaults.
+    moe_lb_cost: float = 0.01
+    moe_z_cost: float = 0.001
 
 
 class TrainState(NamedTuple):
@@ -100,10 +104,22 @@ def impala_loss(
 
     The model is unrolled over all T+1 frames; frame T provides the
     bootstrap value.
+
+    ``apply_fn`` may return an optional THIRD element, a dict of model aux
+    losses (the MoE convention: ``load_balance_loss``, ``router_z_loss``,
+    ``drop_fraction`` from
+    :func:`moolib_tpu.models.transformer.moe_aux_losses`); they are folded
+    into the total with ``config.moe_lb_cost`` / ``config.moe_z_cost`` and
+    surfaced in the metrics so capacity drops are visible in training logs.
     """
-    (logits, baseline), _ = apply_fn(
+    out = apply_fn(
         params, batch["obs"], batch["done"], batch["core_state"]
     )
+    model_aux = None
+    if len(out) == 3:
+        (logits, baseline), _, model_aux = out
+    else:
+        (logits, baseline), _ = out
     logits, bootstrap_value = logits[:-1], baseline[-1]
     baseline = baseline[:-1]
 
@@ -141,6 +157,16 @@ def impala_loss(
         "entropy": entropy,
         "mean_baseline": jnp.mean(baseline),
     }
+    if model_aux is not None:
+        total = (
+            total
+            + config.moe_lb_cost * model_aux["load_balance_loss"]
+            + config.moe_z_cost * model_aux["router_z_loss"]
+        )
+        metrics["total_loss"] = total
+        metrics["moe_lb_loss"] = model_aux["load_balance_loss"]
+        metrics["moe_z_loss"] = model_aux["router_z_loss"]
+        metrics["moe_drop_fraction"] = model_aux["drop_fraction"]
     return total, metrics
 
 

@@ -106,6 +106,7 @@ def pipeline_apply(
     stage_params: Any,
     microbatches: jax.Array,
     axis_name: str = "pp",
+    remat: bool = False,
 ):
     """Run the local microbatch shard through the stage pipeline. Call
     INSIDE shard_map (uses ``axis_index``).
@@ -119,6 +120,13 @@ def pipeline_apply(
       microbatches: ``[k, 1, mb, ...]`` — this device's shard of the
         round-robin layout built by :func:`shard_microbatches` with
         ``in_specs=MICRO_SPEC`` (local slot s = microbatch ``s*pp + d``).
+      remat: rematerialize each stage application in the backward pass
+        (``jax.checkpoint``) instead of stashing its internals — under
+        ``jax.grad`` the scan otherwise saves every tick's stage
+        intermediates, which dominates activation memory for deep stages.
+        With remat the per-tick stash shrinks to the carry, trading one
+        extra stage forward per tick in the backward (the classic
+        activation/FLOPs trade 1F1B also makes).
 
     Returns ``[k, 1, mb, ...]`` output shards in the same layout
     (``out_specs=MICRO_SPEC``; :func:`unshard_microbatches` restores
@@ -132,6 +140,8 @@ def pipeline_apply(
     k = inp0.shape[0]
     n_micro = k * n_stages
     params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     # Activation chain: stage d sends to d+1; stage 0 receives nothing
     # (ppermute delivers zeros to unlisted destinations, which stage 0
     # ignores — it reads from the input shard).

@@ -21,7 +21,44 @@ import threading
 import time
 from typing import Any, Callable, Optional, Tuple
 
-__all__ = ["time_train_step", "install_watchdog", "wait_for_device"]
+__all__ = [
+    "time_train_step",
+    "time_chained",
+    "install_watchdog",
+    "wait_for_device",
+]
+
+
+def time_chained(step, carry, iters: int = 10):
+    """Time ``iters`` data-dependent applications of ``step(carry) ->
+    carry`` chained INSIDE one jit (``lax.fori_loop``), ending in a D2H
+    scalar fingerprint readback — the same honest protocol as
+    :func:`time_train_step` for steps that aren't train-state shaped.
+
+    Returns ``(final_carry, timed_seconds, compile_seconds)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def run_many(carry):
+        c = jax.lax.fori_loop(0, iters, lambda _, c: step(c), carry)
+        fingerprint = sum(
+            jnp.sum(leaf.astype(jnp.float32))
+            for leaf in jax.tree_util.tree_leaves(c)
+        )
+        return c, fingerprint
+
+    t_c = time.perf_counter()
+    carry, fp = run_many(carry)
+    float(fp)
+    compile_s = time.perf_counter() - t_c
+    t0 = time.perf_counter()
+    carry, fp = run_many(carry)
+    assert np.isfinite(float(fp))
+    dt = time.perf_counter() - t0
+    return carry, dt, compile_s
 
 
 def wait_for_device(

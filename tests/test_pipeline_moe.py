@@ -96,6 +96,39 @@ class TestPipeline:
             )
 
 
+    def test_remat_gradients_match(self, rng):
+        """remat=True recomputes stage internals in the backward; the
+        gradients must be bit-compatible with the stashing path."""
+        n_stages, n_micro, F, mb = 4, 4, 6, 3
+        stages = _stages(rng, n_stages, F)
+        x = jnp.asarray(rng.standard_normal((n_micro, mb, F)), jnp.float32)
+        mesh = make_mesh(dp=1, pp=n_stages, devices=jax.devices()[:4])
+        stacked = stack_stage_params(stages)
+
+        def loss(remat):
+            def f(stacked, x):
+                y_sh = jax.shard_map(
+                    lambda p, x: pipeline_apply(
+                        _stage_fn, p, x, axis_name="pp", remat=remat
+                    ),
+                    mesh=mesh,
+                    in_specs=(P("pp"), MICRO_SPEC),
+                    out_specs=MICRO_SPEC,
+                )(stacked, shard_microbatches(x, n_stages))
+                return jnp.sum(unshard_microbatches(y_sh) ** 2)
+            return f
+
+        g_plain = jax.jit(jax.grad(loss(False)))(stacked, x)
+        g_remat = jax.jit(jax.grad(loss(True)))(stacked, x)
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_plain),
+            jax.tree_util.tree_leaves_with_path(g_remat),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6,
+                err_msg=str(pa),
+            )
+
     def test_per_device_memory_scales_with_shard_not_stream(self, rng):
         """The point of sharded microbatches (VERDICT r3 #6): per-device
         activation memory is O(n_micro/pp), not O(n_micro). Compiled

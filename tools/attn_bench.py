@@ -29,20 +29,21 @@ import time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def _time_fn(fn, *args, iters=5):
-    import jax
+def _time_grad_step(grad_fn, q, k, v, iters=5):
+    """Chained honest timing via utils/benchmark.time_chained: iteration
+    i+1's q depends on iteration i's dq (a negligible 1e-30-scaled nudge
+    keeps the data dependency real without changing the numerics), so the
+    runtime cannot pipeline or elide dispatches — the same protocol every
+    other steps/s artifact in this repo uses."""
+    from moolib_tpu.utils.benchmark import time_chained
 
-    out = fn(*args)  # compile
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    # D2H readback defeats any async-dispatch overhang (same protocol as
-    # utils/benchmark.py).
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.reshape(-1)[0])
-    return (time.perf_counter() - t0) / iters
+    def step(c):
+        q, k, v = c
+        dq, _dk, _dv = grad_fn(q, k, v)
+        return (q + (dq * 1e-30).astype(q.dtype), k, v)
+
+    _, dt, _compile_s = time_chained(step, (q, k, v), iters=iters)
+    return dt / iters
 
 
 def attention_flops(B, H, T, D, causal=True):
@@ -74,13 +75,15 @@ def bench_backend(backend, B, H, T, D, dtype, iters, mesh=None):
             zigzag_order, zigzag_ring_attention,
         )
 
-        n = mesh.devices.size
+        # The zigzag layout must match the SP axis size, not the total
+        # device count (dp shards don't participate in the ring).
+        n = mesh.shape["sp"]
         order = zigzag_order(n, T)
         qz, kz, vz = (x[:, :, order, :] for x in (q, k, v))
         spec = NamedSharding(mesh, P(None, None, "sp", None))
         qz, kz, vz = (jax.device_put(x, spec) for x in (qz, kz, vz))
 
-        def step(q, k, v):
+        def grad_fn(q, k, v):
             def loss(q, k, v):
                 o = jax.shard_map(
                     lambda q, k, v: zigzag_ring_attention(
@@ -94,9 +97,7 @@ def bench_backend(backend, B, H, T, D, dtype, iters, mesh=None):
 
             return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
-        fn = jax.jit(step)
-        dt = _time_fn(fn, qz, kz, vz, iters=iters)
-        return dt
+        return _time_grad_step(grad_fn, qz, kz, vz, iters=iters)
 
     fns = {
         "dense": lambda q, k, v: attn_mod.dense_attention(
@@ -111,15 +112,14 @@ def bench_backend(backend, B, H, T, D, dtype, iters, mesh=None):
     }
     inner = fns[backend]
 
-    def step(q, k, v):
+    def grad_fn(q, k, v):
         def loss(q, k, v):
             o = inner(q, k, v)
             return jnp.sum(o.astype(jnp.float32) ** 2)
 
         return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 
-    fn = jax.jit(step)
-    return _time_fn(fn, q, k, v, iters=iters)
+    return _time_grad_step(grad_fn, q, k, v, iters=iters)
 
 
 def validate_flash_nonintepreted(dtype):
