@@ -33,13 +33,18 @@ def main(duration: float = 60.0) -> None:
 
     from moolib_tpu.examples.vtrace.experiment import VtraceConfig, train
 
+    import os as _os
+
     rows = []
     cfg = VtraceConfig(
         env="synthetic",
         actor_batch_size=64,
         learn_batch_size=64,
         virtual_batch_size=64,
-        num_actor_processes=4,
+        # More env workers than cores just thrash the scheduler (this
+        # build host has ONE core; the workers and the learner time-slice
+        # it either way).
+        num_actor_processes=max(1, min(4, _os.cpu_count() or 1)),
         num_actor_batches=2,
         unroll_length=20,
         total_steps=10**9,  # bounded by max_seconds below

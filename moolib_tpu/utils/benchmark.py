@@ -64,7 +64,7 @@ def time_chained(step, carry, iters: int = 10):
 def wait_for_device(
     metric: str,
     budget_env: str = "MOOLIB_BENCH_BUDGET",
-    default_budget: float = 1800.0,
+    default_budget: float = 1000.0,
     probe_interval: float = 60.0,
 ) -> dict:
     """Block until the device tunnel answers, probing in SUBPROCESSES.
@@ -78,8 +78,10 @@ def wait_for_device(
 
     Returns ``{"attempts": n, "waited_s": s, "platform": p}`` once a probe
     sees a device. If the budget (``MOOLIB_BENCH_BUDGET`` seconds, default
-    1800; <=0 probes once) is exhausted, prints the null-value JSON artifact
-    with the probe history and exits 3.
+    1000; <=0 probes once) is exhausted, prints the null-value JSON artifact
+    with the probe history and exits 3. The default stays below the old
+    1200s watchdog so a driver that tolerated that timeout still sees the
+    diagnostic line before losing patience.
     """
     import subprocess
 
