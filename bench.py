@@ -25,7 +25,7 @@ def main() -> None:
     from moolib_tpu.utils.benchmark import install_watchdog, wait_for_device
 
     # Tunnel-flap resilience: probe liveness in subprocesses (bounded by
-    # MOOLIB_BENCH_BUDGET, default 1800s) and only then init jax in-process.
+    # MOOLIB_BENCH_BUDGET, default 1000s) and only then init jax in-process.
     # A tunnel that comes back mid-budget is caught within one probe
     # interval; exhaustion emits the null artifact with the probe history.
     probe = wait_for_device("impala_train_env_steps_per_sec_per_chip")

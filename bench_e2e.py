@@ -43,8 +43,11 @@ def main(duration: float = 60.0) -> None:
         virtual_batch_size=64,
         # More env workers than cores just thrash the scheduler (this
         # build host has ONE core; the workers and the learner time-slice
-        # it either way).
-        num_actor_processes=max(1, min(4, _os.cpu_count() or 1)),
+        # it either way). Must divide actor_batch_size (EnvPool slices
+        # envs evenly), so pick the largest power-of-two divisor <= cores.
+        num_actor_processes=max(
+            w for w in (1, 2, 4) if w <= (_os.cpu_count() or 1) or w == 1
+        ),
         num_actor_batches=2,
         unroll_length=20,
         total_steps=10**9,  # bounded by max_seconds below

@@ -258,3 +258,134 @@ def test_broker_cli_loop(monkeypatch):
     monkeypatch.setattr(cli, "Rpc", FakeRpc)
     cli.main(["127.0.0.1:0", "--interval", "0.001"])
     assert calls["n"] == 3 and calls.get("closed")
+
+
+def test_broker_restart_group_recovers(cluster):
+    """The broker is the single membership authority; a crashed-and-
+    restarted broker must rebuild the group from peer pings and collectives
+    must work again (reference behavior: peers keep pinging, the fresh
+    broker's unknown-epoch response forces a resync — elasticity covers the
+    authority itself, not just members)."""
+    import numpy as np
+
+    for i in range(3):
+        cluster.spawn(f"p{i}")
+    cluster.wait_members("g", 3)
+    futs = [g.all_reduce("pre", np.ones(4)) for _, g in cluster.clients]
+    for f in futs:
+        np.testing.assert_allclose(f.result(10), 3.0)
+
+    # Kill the broker process-equivalent: stop its loop, close its Rpc.
+    cluster._stop.set()
+    cluster._thread.join(timeout=5)
+    addr = cluster.addr
+    cluster.broker_rpc.close()
+
+    # Restart on the SAME address (peers' explicit connections auto-redial).
+    deadline = time.monotonic() + 10
+    new_rpc = None
+    while time.monotonic() < deadline:
+        try:
+            new_rpc = Rpc("broker")
+            new_rpc.listen(addr)
+            break
+        except Exception:
+            new_rpc.close()
+            new_rpc = None
+            time.sleep(0.2)
+    assert new_rpc is not None, "could not rebind broker address"
+    cluster.broker_rpc = new_rpc
+    cluster.broker = Broker(new_rpc)
+    cluster._stop = threading.Event()
+    cluster._thread = threading.Thread(target=cluster._loop, daemon=True)
+    cluster._thread.start()
+
+    # Peers re-register via pings; the new epoch re-forms with all 3.
+    cluster.wait_members("g", 3, timeout=30.0)
+    futs = [g.all_reduce("post", np.ones(4)) for _, g in cluster.clients]
+    for f in futs:
+        np.testing.assert_allclose(f.result(15), 3.0)
+
+
+def test_randomized_churn_allreduce_property(cluster):
+    """Reference-style churn property test (reference strategy:
+    test/test_reduce.py:18-130 — staggered member creation with
+    expected-sum assertions while reduces run continuously): every
+    SUCCESSFUL allreduce of ones must equal the member count of its epoch;
+    failures are legal only as cancellations/timeouts during resync, and
+    once membership settles every peer must succeed again."""
+    import numpy as np
+
+    n_final = 4
+    stagger = [0.0, 0.2, 0.45, 0.8]
+    results = {i: [] for i in range(n_final)}
+    errors = []
+    stop = threading.Event()
+
+    def peer_loop(i):
+        try:
+            time.sleep(stagger[i])
+            rpc, g = cluster.spawn(f"peer{i}")
+
+            def pump():
+                # Expiry/cancel processing must keep running while the
+                # main loop blocks in result() — the production pattern.
+                while not stop.is_set():
+                    g.update()
+                    time.sleep(0.03)
+
+            threading.Thread(target=pump, daemon=True).start()
+            rounds = {}  # sync_id -> next round number (aligns op keys
+            # across peers: every member restarts at r0 in a new epoch)
+            while not stop.is_set():
+                if not g.active():
+                    time.sleep(0.02)
+                    continue
+                s = g.sync_id
+                m_epoch = len(g.members)
+                r = rounds.get(s, 0)
+                rounds[s] = r + 1
+                try:
+                    fut = g.all_reduce(f"r{r}", np.ones(2))
+                except RpcError:
+                    continue  # epoch flipped mid-start
+                try:
+                    out = fut.result(6.0)
+                except (RpcError, TimeoutError):
+                    continue  # cancelled/expired during resync: legal
+                if fut.op_key.startswith(s + "."):
+                    results[i].append((m_epoch, float(out[0])))
+                time.sleep(0.02)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append((i, repr(e)))
+
+    threads = [
+        threading.Thread(target=peer_loop, args=(i,)) for i in range(n_final)
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 25
+    try:
+        cluster.wait_members("g", n_final, timeout=15.0)
+        # Let the settled group produce post-churn successes.
+        while time.monotonic() < deadline:
+            if all(
+                any(m == n_final for m, _ in results[i]) for i in results
+            ):
+                break
+            time.sleep(0.1)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors, errors
+    for i, rows in results.items():
+        assert rows, f"peer {i} never completed a reduce"
+        for m_epoch, value in rows:
+            # Sum of ones over that epoch's members. A result may lag its
+            # epoch only through a full resync, which cancels the op — so
+            # a SUCCESS must match the membership its key was bound to.
+            assert value == m_epoch, (i, m_epoch, value)
+        assert any(m == n_final for m, _ in rows), (
+            f"peer {i} never succeeded at full membership"
+        )
