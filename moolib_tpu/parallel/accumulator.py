@@ -76,11 +76,34 @@ def _tree_add(a, b):
         return b
     if b is None:
         return a
-    return nest.map_structure(np.add, a, b)
+    # asarray: np.add on two 0-d arrays returns a numpy SCALAR, which would
+    # make chunk eligibility (an all-ndarray check in rpc/group.py) diverge
+    # between peers that accumulated 2+ contributions and peers that did
+    # not — divergent wire formats deadlock the round.
+    return nest.map_structure(
+        lambda x, y: np.asarray(np.add(x, y)), a, b
+    )
 
 
 def _elect_max(a, b):
     return max(a, b)
+
+
+class _LeafSpec:
+    """Shape/dtype of one bundle leaf. A class, not a tuple: template trees
+    run through nest.map_structure, which would recurse into tuples."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = shape
+        self.dtype = dtype
+
+
+def _bundle_spec(tree):
+    return nest.map_structure(
+        lambda x: _LeafSpec(np.shape(x), np.asarray(x).dtype), tree
+    )
 
 
 def _grad_merge(a, b):
@@ -90,8 +113,18 @@ def _grad_merge(a, b):
 
 
 def _count_merge(a, b):
-    (bsa, nga), (bsb, ngb) = a, b
-    return (bsa + bsb, nga + ngb)
+    """Merge (batch_size, n_grads, has_template) triples.
+
+    ``has_template`` ANDs across members: the count result is identical on
+    every peer (it is an allreduce), so it doubles as the NEGOTIATION of
+    the gradient round's wire format — chunked builtin-sum (pipelined
+    through the tree, see rpc/group.py chunking) is only legal when EVERY
+    member can construct a structurally-identical payload, i.e. owns a
+    bundle template. A fresh joiner flips one round back to the
+    None-tolerant custom merge, then learns the template from that round's
+    result."""
+    (bsa, nga, ta), (bsb, ngb, tb) = a, b
+    return (bsa + bsb, nga + ngb, ta and tb)
 
 
 class Accumulator:
@@ -151,6 +184,11 @@ class Accumulator:
         self._pending_bundle = None              # user grads since last round
         self._pending_bs = 0
         self._pending_ngrads = 0
+        # Bundle shape/dtype spec — once known, gradient rounds negotiate
+        # the chunked builtin-sum wire format (see _count_merge docstring).
+        # Survives epochs: it describes the model, not the membership.
+        self._bundle_template: Optional[Any] = None
+        self._chunked_rounds = 0                 # observability/testing
         self._committed_bundle = None            # counted, awaiting grad round
         self._committed_bs = 0
         self._committed_ngrads = 0
@@ -241,6 +279,8 @@ class Accumulator:
             self._pending_bs += int(batch_size)
             self._pending_ngrads += 1
             self._user_has_contributed = True
+            if self._bundle_template is None:
+                self._bundle_template = _bundle_spec(tree)
 
     def skip_gradients(self):
         """Explicitly contribute nothing this cycle (reference contract)."""
@@ -509,7 +549,7 @@ class Accumulator:
 
         def done(fut):
             try:
-                total_bs, total_ng = fut.result(timeout=0)
+                total_bs, total_ng, all_templ = fut.result(timeout=0)
             except Exception:
                 with self._lock:
                     restore_snapshot_locked()
@@ -546,11 +586,17 @@ class Accumulator:
                     self.virtual_batch_size
                     <= self._cumulative_bs
                 ):
-                    self._start_grad_round(self._cumulative_bs)
+                    # all_templ is identical on every member (it came out
+                    # of the allreduce), so every member picks the same
+                    # wire format for this gradient round.
+                    self._start_grad_round(
+                        self._cumulative_bs, chunked=bool(all_templ)
+                    )
 
         try:
             fut = self.group.all_reduce(
-                f"acc.count.{seq}.{self._attempt}", (snap_bs, snap_ng),
+                f"acc.count.{seq}.{self._attempt}",
+                (snap_bs, snap_ng, self._bundle_template is not None),
                 op=_count_merge,
             )
         except RpcError:
@@ -578,13 +624,22 @@ class Accumulator:
             # race-free while _model_version keeps moving on RPC threads.
             self._results.append((out[0], out[1], self._model_version))
 
-    def _start_grad_round(self, count: int):
+    def _start_grad_round(self, count: int, chunked: bool = False):
         """All peers enter deterministically once counts cross the virtual
         batch size (reference: startReduce, src/accumulator.cc:1005-1033).
 
         The round key (gseq) is claimed at START — grad-round starts are
         triggered inside count-round completions, which are totally ordered,
         so keys agree across peers even with several rounds in flight.
+
+        ``chunked`` (negotiated through the count round, identical on every
+        member): the payload becomes ``{"b": bundle-or-zeros, "n": [ng]}``
+        under the BUILTIN sum — the group layer then pipelines it through
+        the tree as a bounded number of concurrent chunks (size
+        ``max(_CHUNK_BYTES, total/_CHUNK_DEPTH)``, see rpc/group.py) with
+        in-place merges, where the None-tolerant custom merge ships one
+        monolithic message per hop. Non-contributors pay a zeros bundle;
+        contributors (the common steady-state case) pay nothing extra.
         """
         epoch = self._epoch
         gseq = self._gseq
@@ -596,6 +651,8 @@ class Accumulator:
         self._committed_ngrads = 0
         self._grads_inflight += 1
         self._cumulative_bs = 0
+        if chunked:
+            self._chunked_rounds += 1
 
         def settle_locked(outcome):
             """Park this round's outcome, release any now-contiguous ones."""
@@ -605,7 +662,12 @@ class Accumulator:
 
         def done(fut):
             try:
-                total_bundle, total_ng = fut.result(timeout=0)
+                if chunked:
+                    res = fut.result(timeout=0)
+                    total_ng = int(res["n"][0])
+                    total_bundle = res["b"] if total_ng > 0 else None
+                else:
+                    total_bundle, total_ng = fut.result(timeout=0)
             except Exception as e:
                 with self._lock:
                     if self._epoch == epoch:
@@ -623,15 +685,35 @@ class Accumulator:
                 if total_bundle is None:
                     settle_locked(None)  # nobody contributed
                     return
+                if self._bundle_template is None:
+                    # Joiner: the first observed result teaches the wire
+                    # shape, flipping future rounds to the chunked format.
+                    self._bundle_template = _bundle_spec(total_bundle)
                 mean = nest.map_structure(
                     lambda x: x / count, total_bundle
                 )
                 settle_locked((mean, count))
 
         try:
-            fut = self.group.all_reduce(
-                f"acc.grads.{gseq}", (bundle, ngrads), op=_grad_merge
-            )
+            if chunked:
+                payload_bundle = (
+                    bundle
+                    if bundle is not None
+                    else nest.map_structure(
+                        lambda spec: np.zeros(spec.shape, spec.dtype),
+                        self._bundle_template,
+                    )
+                )
+                fut = self.group.all_reduce(
+                    f"acc.grads.{gseq}",
+                    {"b": payload_bundle,
+                     "n": np.array([ngrads], np.int64)},
+                    op="sum",
+                )
+            else:
+                fut = self.group.all_reduce(
+                    f"acc.grads.{gseq}", (bundle, ngrads), op=_grad_merge
+                )
         except RpcError:
             # Mirror the async-failure path so this peer's release cursor
             # doesn't fall permanently behind the cluster's round keys.
@@ -650,6 +732,7 @@ class Accumulator:
                 "cumulative_batch_size": self._cumulative_bs,
                 "count_rounds": self._seq,
                 "gradient_rounds": self._gseq,
+                "chunked_gradient_rounds": self._chunked_rounds,
                 "gradient_rounds_inflight": self._grads_inflight,
                 "results_queued": len(self._results),
                 "parallel_gradients": self._parallel,

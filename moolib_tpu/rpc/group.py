@@ -54,19 +54,19 @@ REDUCE_OPS: Dict[str, Callable] = {
     "max": _max,
 }
 
-# Elementwise builtin ops can be reduced chunk-by-chunk; payloads above the
-# threshold are split into ~_CHUNK_BYTES pieces that flow through the tree as
-# independent concurrent sub-ops. This pipelines the hops (chunk i reduces
-# while chunk i+1 is in transit) and spreads the numpy reduction over the
-# executor threads, where the unchunked path serializes full-buffer
-# transfer -> add -> transfer per tree level.
+# Elementwise builtin ops can be reduced chunk-by-chunk; large payloads are
+# split into a BOUNDED number of pieces (pipeline depth _CHUNK_DEPTH) that
+# flow through the tree as independent concurrent sub-ops, overlapping hop
+# i's transfer with hop i+1's merge on DIFFERENT hosts. Chunk size floors
+# at _CHUNK_BYTES: depth beyond ~4 only multiplies per-message overhead
+# (measured: on a single-core loopback — zero cross-host concurrency to
+# exploit — chunking is pure overhead, so the floor keeps the message
+# count small; on multi-host DCN the depth-4 pipeline is the win).
 _ELEMENTWISE = frozenset({_sum, _prod, _min, _max})
-# 4MB default: measured on the loopback tree bench, per-message overhead
-# dominates below ~2MB chunks and pipelining gains flatten above ~4MB
-# (tools/allreduce_decomp.py records the sweep).
 _CHUNK_BYTES = int(__import__("os").environ.get(
-    "MOOLIB_TPU_ALLREDUCE_CHUNK", 1 << 22
+    "MOOLIB_TPU_ALLREDUCE_CHUNK", 1 << 23
 ))
+_CHUNK_DEPTH = 4
 _CHUNK_THRESHOLD = 2 * _CHUNK_BYTES if _CHUNK_BYTES else (1 << 62)
 
 
@@ -319,13 +319,18 @@ class Group:
         member), so all peers produce matching sub-op keys. Each sub-op's
         payload is a flat list of array views; the parent future reassembles
         the original pytree when the last sub-op lands."""
+        # Bounded pipeline depth: chunk = max(floor, total/_CHUNK_DEPTH).
+        total_bytes = sum(x.nbytes for x in leaves)
+        chunk_bytes = max(
+            _CHUNK_BYTES, -(-total_bytes // _CHUNK_DEPTH)
+        )
         pieces: List[tuple] = []  # (leaf_idx, flat view)
         for li, leaf in enumerate(leaves):
             if not leaf.flags.c_contiguous:
                 leaf = np.ascontiguousarray(leaf)
             flat = leaf.reshape(-1)
-            per = max(1, _CHUNK_BYTES // max(1, flat.itemsize))
-            if flat.nbytes <= _CHUNK_BYTES:
+            per = max(1, chunk_bytes // max(1, flat.itemsize))
+            if flat.nbytes <= chunk_bytes:
                 pieces.append((li, flat))
             else:
                 for s in range(0, flat.size, per):
@@ -334,7 +339,7 @@ class Group:
         cur: List[tuple] = []
         cur_bytes = 0
         for p in pieces:
-            if cur and cur_bytes + p[1].nbytes > _CHUNK_BYTES:
+            if cur and cur_bytes + p[1].nbytes > chunk_bytes:
                 groups.append(cur)
                 cur, cur_bytes = [], 0
             cur.append(p)

@@ -255,3 +255,57 @@ def test_leader_broadcast_heals_drift(cluster):
     _pump(accs, lambda: np.array_equal(member_state["w"], leader_state["w"]),
           timeout=15)
     assert member.model_version == 3
+
+
+def test_chunked_wire_format_negotiation(cluster):
+    """Steady-state gradient rounds negotiate the chunked builtin-sum wire
+    format through the count round (all members hold a bundle template);
+    a template-less participant (never contributed, nothing observed)
+    flips the round back to the None-tolerant custom merge. Both formats
+    must produce identical means."""
+    n, vbs = 3, 6
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=vbs) for i in range(n)]
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients()
+                            for a in accs))
+
+    # ABOVE the 2*_CHUNK_BYTES threshold so round B genuinely chunks
+    # through the tree (5M f32 = 20MB > 16MB).
+    big = np.ones(5 << 20, dtype=np.float32)
+
+    # Round A: only peers 0 and 1 contribute; peer 2 skips and has NO
+    # template -> negotiation must pick the custom format.
+    for i in (0, 1):
+        accs[i].reduce_gradients({"w": big * (i + 1), "b": np.float64(2.0)},
+                                 batch_size=3)
+    accs[2].skip_gradients()
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+    for a in accs:
+        res, count = a.result_gradients()
+        assert count == 6
+        np.testing.assert_allclose(res["w"], big * 3 / 6)
+        a.zero_gradients()
+    assert all(a.get_gradient_stats()["chunked_gradient_rounds"] == 0
+               for a in accs), "round A must be custom (peer2 template-less)"
+    # Peer 2 observed round A's result -> now owns a template.
+
+    # Round B: all peers have templates; peer 2 skips again (ships a zeros
+    # bundle), peer 0 contributes TWICE (its 0-d bias leaf must stay an
+    # ndarray through _tree_add or peers take divergent chunked/unchunked
+    # formats and the round deadlocks), peer 1 once.
+    _pump(accs, lambda: all(a.wants_gradients() for a in accs))
+    accs[0].reduce_gradients({"w": big, "b": np.float64(1.0)}, batch_size=2)
+    accs[0].reduce_gradients({"w": big, "b": np.float64(1.0)}, batch_size=1)
+    accs[1].reduce_gradients({"w": big, "b": np.float64(1.0)}, batch_size=3)
+    accs[2].skip_gradients()
+    _pump(accs, lambda: all(a.has_gradients() for a in accs), timeout=60.0)
+    for a in accs:
+        res, count = a.result_gradients()
+        assert count == 6
+        np.testing.assert_allclose(res["w"], big * 3 / 6)
+        np.testing.assert_allclose(res["b"], 3.0 / 6)
+        a.zero_gradients()
+    assert all(a.get_gradient_stats()["chunked_gradient_rounds"] == 1
+               for a in accs), (
+        "round B must negotiate chunked",
+        [a.get_gradient_stats() for a in accs],
+    )
