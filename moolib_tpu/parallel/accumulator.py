@@ -188,6 +188,11 @@ class Accumulator:
         # the chunked builtin-sum wire format (see _count_merge docstring).
         # Survives epochs: it describes the model, not the membership.
         self._bundle_template: Optional[Any] = None
+        # Cached zeros payload for skipped chunked rounds: the group layer
+        # never mutates caller payloads (copy-on-first-merge), so one
+        # allocation serves every skipped round instead of an O(model)
+        # build under the lock each time.
+        self._zeros_bundle: Optional[Any] = None
         self._chunked_rounds = 0                 # observability/testing
         self._committed_bundle = None            # counted, awaiting grad round
         self._committed_bs = 0
@@ -696,14 +701,15 @@ class Accumulator:
 
         try:
             if chunked:
-                payload_bundle = (
-                    bundle
-                    if bundle is not None
-                    else nest.map_structure(
-                        lambda spec: np.zeros(spec.shape, spec.dtype),
-                        self._bundle_template,
-                    )
-                )
+                if bundle is not None:
+                    payload_bundle = bundle
+                else:
+                    if self._zeros_bundle is None:
+                        self._zeros_bundle = nest.map_structure(
+                            lambda spec: np.zeros(spec.shape, spec.dtype),
+                            self._bundle_template,
+                        )
+                    payload_bundle = self._zeros_bundle
                 fut = self.group.all_reduce(
                     f"acc.grads.{gseq}",
                     {"b": payload_bundle,

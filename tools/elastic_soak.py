@@ -1,0 +1,231 @@
+"""Sustained-churn soak: random SIGKILL/replace cycles against a live
+elastic training cluster, minutes at a time.
+
+The elastic membership story (the reference's flagship capability) is
+covered by bounded tests (one SIGKILL, one join); this tool subjects it to
+SUSTAINED churn: N vtrace peers train CartPole against one broker while a
+conductor SIGKILLs a random peer and boots a replacement every
+``--kill-interval`` seconds for ``--minutes``. Pass criteria:
+
+- cluster-wide progress NEVER stalls: the max ``updates`` across live
+  peers' logs advances in every ``--stall-window``-second window;
+- every replacement peer reaches its first update (joins, syncs state,
+  trains) before the next kill cycle ends;
+- at the end, all surviving peers are still updating.
+
+Writes SOAK_r04.json with the churn history and progress timeline.
+
+Usage: python tools/elastic_soak.py [--minutes 5] [--peers 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _peer_cmd(broker_addr, savedir):
+    return [
+        sys.executable, "-m", "moolib_tpu.examples.vtrace.experiment",
+        f"broker={broker_addr}",
+        f"savedir={savedir}",
+        "env=cartpole",
+        "total_steps=100000000",
+        "actor_batch_size=8",
+        "learn_batch_size=8",
+        "virtual_batch_size=16",
+        "num_actor_processes=1",
+        "unroll_length=5",
+        "log_interval_steps=200",
+        "stats_interval=0.5",
+    ]
+
+
+def _spawn_peer(broker_addr, root, idx):
+    savedir = os.path.join(root, f"peer{idx}")
+    os.makedirs(savedir, exist_ok=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        _peer_cmd(broker_addr, savedir), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    return {"proc": proc, "savedir": savedir, "idx": idx,
+            "born": time.monotonic()}
+
+
+def _updates(savedir):
+    from moolib_tpu.examples.plot import read_tsv
+
+    path = os.path.join(savedir, "logs.tsv")
+    if not os.path.exists(path):
+        return 0
+    try:
+        rows = read_tsv(path)
+    except Exception:
+        return 0
+    return int(rows[-1].get("updates", 0)) if rows else 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=5.0)
+    ap.add_argument("--peers", type=int, default=3)
+    ap.add_argument("--kill-interval", type=float, default=25.0)
+    ap.add_argument("--stall-window", type=float, default=45.0)
+    ap.add_argument("--startup-timeout", type=float, default=300.0,
+                    help="grace for first progress (N peers serialize "
+                    "jit compiles on small hosts) before churn begins")
+    ap.add_argument("--json", default="SOAK_r04.json")
+    args = ap.parse_args()
+
+    import moolib_tpu
+    from moolib_tpu.examples.common import InProcessBroker
+
+    moolib_tpu.set_log_level("error")
+    broker = InProcessBroker()
+    root = tempfile.mkdtemp(prefix="soak_")
+    rng = random.Random(0)
+
+    peers = [_spawn_peer(broker.address, root, i)
+             for i in range(args.peers)]
+    next_idx = args.peers
+    history = []
+    timeline = []
+    best_seen = 0
+    ok, fail_reason = True, None
+    t0 = time.monotonic()
+
+    # Churn a RUNNING cluster: wait for first progress before the clock and
+    # the kill cycles start (peers serialize their jit compiles on small
+    # hosts; killing mid-compile only measures the host, not elasticity).
+    startup_deadline = t0 + args.startup_timeout
+    while time.monotonic() < startup_deadline:
+        best_seen = max(
+            (_updates(p["savedir"]) for p in peers), default=0
+        )
+        if best_seen > 0:
+            break
+        time.sleep(2.0)
+    if best_seen == 0:
+        ok, fail_reason = False, (
+            f"cluster never produced an update within "
+            f"{args.startup_timeout}s of startup"
+        )
+
+    t_end = time.monotonic() + args.minutes * 60
+    last_kill = time.monotonic()
+    last_advance = time.monotonic()
+    try:
+        while ok and time.monotonic() < t_end:
+            time.sleep(2.0)
+            now = time.monotonic()
+            # Progress = any live peer's OWN update counter advancing
+            # (replacement peers restart their counters at zero, so a
+            # cluster-max metric would freeze whenever the most-advanced
+            # peer is the one killed).
+            advanced = False
+            total_now = 0
+            for p in peers:
+                u = _updates(p["savedir"])
+                total_now += u
+                if u > p.get("last_updates", 0):
+                    p["last_updates"] = u
+                    advanced = True
+            best_seen = max(best_seen, total_now)
+            timeline.append(
+                {"t": round(now - t0, 1), "live_updates_sum": total_now,
+                 "alive": sum(p["proc"].poll() is None for p in peers)}
+            )
+            if advanced:
+                last_advance = now
+            elif now - last_advance > args.stall_window:
+                ok, fail_reason = False, (
+                    f"no progress for {args.stall_window}s at "
+                    f"updates={best_seen}"
+                )
+                break
+            # Unexpected deaths (not ours) fail the soak.
+            for p in peers:
+                rc = p["proc"].poll()
+                if rc is not None and not p.get("killed"):
+                    ok, fail_reason = False, (
+                        f"peer{p['idx']} died uncommanded (rc={rc})"
+                    )
+                    break
+            if not ok:
+                break
+            if now - last_kill >= args.kill_interval:
+                last_kill = now
+                victim = rng.choice(peers)
+                victim["killed"] = True
+                try:
+                    victim["proc"].send_signal(signal.SIGKILL)
+                except Exception:
+                    pass
+                peers.remove(victim)
+                repl = _spawn_peer(broker.address, root, next_idx)
+                peers.append(repl)
+                history.append(
+                    {"t": round(now - t0, 1),
+                     "killed": victim["idx"], "spawned": next_idx,
+                     "victim_updates": victim.get("last_updates", 0)}
+                )
+                print(json.dumps(history[-1]), flush=True)
+                next_idx += 1
+    finally:
+        for p in peers:
+            try:
+                p["proc"].send_signal(signal.SIGKILL)
+            except Exception:
+                pass
+        broker.close()
+
+    # Every replacement must have reached its first update, except ones
+    # born within the last kill cycle (not enough time to compile+join).
+    late_born = time.monotonic() - args.kill_interval - 30
+    stragglers = [
+        p["idx"] for p in peers
+        if p["born"] < late_born and _updates(p["savedir"]) == 0
+    ]
+    if ok and stragglers:
+        ok, fail_reason = False, f"replacements never trained: {stragglers}"
+
+    art = {
+        "round": 4,
+        "cmd": (
+            f"python tools/elastic_soak.py --minutes {args.minutes} "
+            f"--peers {args.peers} --kill-interval {args.kill_interval}"
+        ),
+        "ok": ok,
+        "fail_reason": fail_reason,
+        "kills": len(history),
+        "peak_live_updates_sum": best_seen,
+        "churn_history": history,
+        "progress_timeline": timeline[-30:],
+        "note": (
+            "sustained random SIGKILL/replace churn against a live elastic "
+            "cluster; pass = cluster-wide updates never stall a full "
+            "window, no uncommanded deaths, replacements train"
+        ),
+    }
+    with open(args.json, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps({"ok": ok, "kills": len(history),
+                      "peak_live_updates_sum": best_seen,
+                      "fail_reason": fail_reason}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

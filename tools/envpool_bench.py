@@ -1,0 +1,86 @@
+"""EnvPool plumbing throughput: a trivial env through the full native
+shm+semaphore dispatch path, double-buffered.
+
+Measures the acting plane's machinery ceiling (slab writes, SPSC ring
+dispatch, process-shared semaphores, the worker's Python step loop) with
+env cost ~zero — real envs add their own step time on top. Mirrors the
+role of the reference's zero-copy EnvStepper design (reference:
+src/env.cc:273-412).
+
+Usage: python tools/envpool_bench.py [--json ENVPOOL_r04.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def bench(procs: int, bs: int) -> dict:
+    import numpy as np
+
+    from fake_env import FakeEnv
+    from moolib_tpu.envpool import EnvPool
+
+    pool = EnvPool(
+        FakeEnv, num_processes=procs, batch_size=bs, num_batches=2
+    )
+    try:
+        a = np.zeros(bs, np.int64)
+        for b in (0, 1):
+            pool.step(b, a).result(30)
+        n = max(50, 20000 // bs)
+        t0 = time.perf_counter()
+        f0 = pool.step(0, a)
+        f1 = pool.step(1, a)
+        for _ in range(n):
+            f0.result(30)
+            f0 = pool.step(0, a)
+            f1.result(30)
+            f1 = pool.step(1, a)
+        f0.result(30)
+        f1.result(30)
+        dt = time.perf_counter() - t0
+        batches = 2 * n + 2
+        return {
+            "env_steps_per_sec": round(batches * bs / dt, 0),
+            "us_per_batch": round(dt / batches * 1e6, 1),
+        }
+    finally:
+        pool.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    results = {}
+    for procs, bs in ((1, 32), (1, 128), (1, 512)):
+        key = f"p{procs}_b{bs}"
+        results[key] = bench(procs, bs)
+        print(json.dumps({key: results[key]}), flush=True)
+    art = {
+        "round": 4,
+        "cmd": "python tools/envpool_bench.py",
+        "host": f"{os.cpu_count()}-core build host",
+        "note": (
+            "trivial-env ceiling of the acting plane: shm slab writes, "
+            "SPSC ring dispatch, process-shared semaphores, worker Python "
+            "env.step loop; real env cost adds on top"
+        ),
+        "results": results,
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(art, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
