@@ -31,6 +31,24 @@ def _stages(rng, n_stages, F):
     ]
 
 
+def _pipe_loss(mesh, n_stages, remat=False):
+    """Shared sum-of-squares loss through the sharded microbatch pipeline
+    (one construction for every TestPipeline case)."""
+
+    def loss(stacked, x):
+        y_sh = jax.shard_map(
+            lambda p, x: pipeline_apply(
+                _stage_fn, p, x, axis_name="pp", remat=remat
+            ),
+            mesh=mesh,
+            in_specs=(P("pp"), MICRO_SPEC),
+            out_specs=MICRO_SPEC,
+        )(stacked, shard_microbatches(x, n_stages))
+        return jnp.sum(unshard_microbatches(y_sh) ** 2)
+
+    return loss
+
+
 class TestPipeline:
     @pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 8)])
     def test_matches_sequential(self, rng, n_stages, n_micro):
@@ -75,15 +93,7 @@ class TestPipeline:
                 )
             return jnp.sum(y**2)
 
-        def pipe_loss(stacked, x):
-            y_sh = jax.shard_map(
-                lambda p, x: pipeline_apply(_stage_fn, p, x, axis_name="pp"),
-                mesh=mesh,
-                in_specs=(P("pp"), MICRO_SPEC),
-                out_specs=MICRO_SPEC,
-            )(stacked, shard_microbatches(x, n_stages))
-            return jnp.sum(unshard_microbatches(y_sh) ** 2)
-
+        pipe_loss = _pipe_loss(mesh, n_stages)
         g_ref = jax.grad(ref_loss)(stacked, x)
         g_pipe = jax.jit(jax.grad(pipe_loss))(stacked, x)
         for (pa, a), (_, b) in zip(
@@ -105,21 +115,12 @@ class TestPipeline:
         mesh = make_mesh(dp=1, pp=n_stages, devices=jax.devices()[:4])
         stacked = stack_stage_params(stages)
 
-        def loss(remat):
-            def f(stacked, x):
-                y_sh = jax.shard_map(
-                    lambda p, x: pipeline_apply(
-                        _stage_fn, p, x, axis_name="pp", remat=remat
-                    ),
-                    mesh=mesh,
-                    in_specs=(P("pp"), MICRO_SPEC),
-                    out_specs=MICRO_SPEC,
-                )(stacked, shard_microbatches(x, n_stages))
-                return jnp.sum(unshard_microbatches(y_sh) ** 2)
-            return f
-
-        g_plain = jax.jit(jax.grad(loss(False)))(stacked, x)
-        g_remat = jax.jit(jax.grad(loss(True)))(stacked, x)
+        g_plain = jax.jit(
+            jax.grad(_pipe_loss(mesh, n_stages, remat=False))
+        )(stacked, x)
+        g_remat = jax.jit(
+            jax.grad(_pipe_loss(mesh, n_stages, remat=True))
+        )(stacked, x)
         for (pa, a), (_, b) in zip(
             jax.tree_util.tree_leaves_with_path(g_plain),
             jax.tree_util.tree_leaves_with_path(g_remat),
@@ -128,6 +129,35 @@ class TestPipeline:
                 np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6,
                 err_msg=str(pa),
             )
+
+    def test_remat_reduces_backward_memory(self, rng):
+        """remat=True must strictly shrink compiled backward temp memory
+        (the stage-internal stash is recomputed instead of stored) — the
+        activation/FLOPs trade the docstring promises."""
+        n_stages, mb, F = 4, 8, 32
+        n_micro = 16
+        stages = _stages(rng, n_stages, F)
+        x = jnp.asarray(
+            rng.standard_normal((n_micro, mb, F)), jnp.float32
+        )
+        mesh = make_mesh(dp=1, pp=n_stages, devices=jax.devices()[:4])
+        stacked = stack_stage_params(stages)
+
+        def compiled_grad(remat):
+            return (
+                jax.jit(jax.grad(_pipe_loss(mesh, n_stages, remat=remat)))
+                .lower(stacked, x)
+                .compile()
+                .memory_analysis()
+            )
+
+        mem_plain = compiled_grad(False)
+        mem_remat = compiled_grad(True)
+        if mem_plain is None or mem_remat is None:
+            pytest.skip("backend exposes no memory analysis")
+        assert (
+            mem_remat.temp_size_in_bytes < mem_plain.temp_size_in_bytes
+        ), (mem_remat.temp_size_in_bytes, mem_plain.temp_size_in_bytes)
 
     def test_per_device_memory_scales_with_shard_not_stream(self, rng):
         """The point of sharded microbatches (VERDICT r3 #6): per-device
