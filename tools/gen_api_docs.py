@@ -138,6 +138,8 @@ def _index() -> str:
         lines.append(f"| [`{path}`](api/{fname}) | {role} |")
     lines += [
         "",
+        "Architecture overview: [design.md](design.md).",
+        "",
         "Other entry points:",
         "",
         "- `bench.py` — headline learner benchmark (one JSON line).",
