@@ -113,18 +113,26 @@ def _grad_merge(a, b):
 
 
 def _count_merge(a, b):
-    """Merge (batch_size, n_grads, has_template) triples.
+    """Merge (batch_size, n_grads, has_template, requested_vbs) tuples.
 
-    ``has_template`` ANDs across members: the count result is identical on
-    every peer (it is an allreduce), so it doubles as the NEGOTIATION of
-    the gradient round's wire format — chunked builtin-sum (pipelined
-    through the tree, see rpc/group.py chunking) is only legal when EVERY
-    member can construct a structurally-identical payload, i.e. owns a
-    bundle template. A fresh joiner flips one round back to the
-    None-tolerant custom merge, then learns the template from that round's
-    result."""
-    (bsa, nga, ta), (bsb, ngb, tb) = a, b
-    return (bsa + bsb, nga + ngb, ta and tb)
+    The count result is identical on every peer (it is an allreduce), so
+    it doubles as the NEGOTIATION channel for everything the following
+    gradient round must agree on:
+
+    - ``has_template`` ANDs across members: the chunked builtin-sum wire
+      format (pipelined through the tree, see rpc/group.py chunking) is
+      only legal when EVERY member can construct a structurally-identical
+      payload, i.e. owns a bundle template. A fresh joiner flips one round
+      back to the None-tolerant custom merge, then learns the template
+      from that round's result.
+    - ``requested_vbs`` MAXes across members: the virtual-batch threshold
+      each completion compares against is the ALLREDUCED value, so a
+      ``set_virtual_batch_size`` call racing in-flight count rounds can
+      never make peers disagree about whether a round triggered (a purely
+      local threshold could fire on one peer's completion and not
+      another's, silently desynchronizing gradient means)."""
+    (bsa, nga, ta, va), (bsb, ngb, tb, vb) = a, b
+    return (bsa + bsb, nga + ngb, ta and tb, max(va, vb))
 
 
 class Accumulator:
@@ -150,12 +158,17 @@ class Accumulator:
         parallel_gradients: int = 1,
         state_broadcast_interval: Optional[float] = 600.0,
     ):
+        # Validate BEFORE any side effect: creating the Group registers
+        # service handlers on the rpc, which must not happen for a
+        # constructor call that raises.
+        if virtual_batch_size < 1:
+            raise ValueError("virtual_batch_size must be >= 1")
         self.rpc = rpc
         self.group = group or Group(
             rpc, broker_name=broker_name, group_name=group_name, timeout=timeout
         )
         self._owns_group = group is None
-        self.virtual_batch_size = virtual_batch_size
+        self.virtual_batch_size = int(virtual_batch_size)
         self._get_state = get_state
         self._set_state = set_state
 
@@ -226,8 +239,26 @@ class Accumulator:
     def is_leader(self) -> bool:
         return self._leader == self.rpc.get_name()
 
+    def get_leader(self) -> Optional[str]:
+        """Name of the current leader, or None before the first election
+        (reference: get_leader, src/moolib.cc)."""
+        return self._leader
+
     def connected(self) -> bool:
         return self.group.active() and self._leader is not None
+
+    def set_virtual_batch_size(self, n: int):
+        """Change the virtual batch size (reference:
+        set_virtual_batch_size, src/moolib.cc). Takes effect at a
+        deterministic round boundary: the value rides the count allreduce
+        (members MAX their requests), so even calls racing in-flight
+        rounds cannot make peers disagree about when a gradient round
+        triggered. Members should still converge on one value — until
+        they do, the largest request governs."""
+        if n < 1:
+            raise ValueError("virtual_batch_size must be >= 1")
+        with self._lock:
+            self.virtual_batch_size = int(n)
 
     def set_parallel_gradients(self, n: int):
         """Allow up to ``n`` gradient reductions in flight / unapplied
@@ -554,7 +585,9 @@ class Accumulator:
 
         def done(fut):
             try:
-                total_bs, total_ng, all_templ = fut.result(timeout=0)
+                total_bs, total_ng, all_templ, eff_vbs = fut.result(
+                    timeout=0
+                )
             except Exception:
                 with self._lock:
                     restore_snapshot_locked()
@@ -587,13 +620,12 @@ class Accumulator:
                 self._committed_bs += snap_bs
                 self._committed_ngrads += snap_ng
                 self._cumulative_bs += total_bs
-                if (
-                    self.virtual_batch_size
-                    <= self._cumulative_bs
-                ):
-                    # all_templ is identical on every member (it came out
-                    # of the allreduce), so every member picks the same
-                    # wire format for this gradient round.
+                # eff_vbs and all_templ are identical on every member
+                # (they came out of the allreduce), so every member makes
+                # the same trigger decision and picks the same wire format
+                # — regardless of when a local set_virtual_batch_size call
+                # landed relative to this completion.
+                if eff_vbs <= self._cumulative_bs:
                     self._start_grad_round(
                         self._cumulative_bs, chunked=bool(all_templ)
                     )
@@ -601,7 +633,8 @@ class Accumulator:
         try:
             fut = self.group.all_reduce(
                 f"acc.count.{seq}.{self._attempt}",
-                (snap_bs, snap_ng, self._bundle_template is not None),
+                (snap_bs, snap_ng, self._bundle_template is not None,
+                 self.virtual_batch_size),
                 op=_count_merge,
             )
         except RpcError:

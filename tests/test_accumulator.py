@@ -309,3 +309,39 @@ def test_chunked_wire_format_negotiation(cluster):
         "round B must negotiate chunked",
         [a.get_gradient_stats() for a in accs],
     )
+
+
+def test_get_leader_and_set_virtual_batch_size(cluster):
+    """Reference binding-surface parity: get_leader names the elected peer
+    everywhere; set_virtual_batch_size (same value on every member, the
+    construction contract) changes the trigger for future rounds."""
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=4) for i in range(2)]
+    _pump(accs, lambda: all(a.connected() and a.wants_gradients()
+                            for a in accs))
+    leaders = {a.get_leader() for a in accs}
+    assert len(leaders) == 1 and leaders != {None}
+
+    # Lower the threshold on ONE peer only: the count allreduce MAXes the
+    # requests, so the old (larger) threshold governs — no round triggers
+    # below it on either peer, even though peer 0 would locally fire.
+    accs[0].set_virtual_batch_size(2)
+    accs[0].reduce_gradients({"w": np.ones(4)}, batch_size=2)
+    accs[1].skip_gradients()
+    time.sleep(0.5)
+    for a in accs:
+        a.update()
+    assert not any(a.has_gradients() for a in accs)
+
+    # Once both peers request it, one contribution of 2 fills the batch.
+    accs[1].set_virtual_batch_size(2)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+    for a in accs:
+        res, count = a.result_gradients()
+        assert count == 2
+        np.testing.assert_allclose(res["w"], np.ones(4) / 2)
+        a.zero_gradients()
+
+    with pytest.raises(ValueError):
+        accs[0].set_virtual_batch_size(0)
+    with pytest.raises(ValueError):
+        Accumulator(cluster.clients[0][0], virtual_batch_size=0)
