@@ -104,6 +104,12 @@ class Broker:
             entry.last_ping = time.monotonic()
             entry.timeout = timeout
             entry.synced_id = sync_id
+            if entry.sort_order != sort_order:
+                # Reordering is a membership-visible change: rank and tree
+                # position depend on it, so push a fresh epoch (reference
+                # refreshes sortOrder at each resync ACK, src/broker.h:161).
+                entry.sort_order = sort_order
+                g.needs_update = True
             return {"sync_id": g.sync_id}
 
     # -- 4Hz maintenance loop ------------------------------------------------

@@ -175,6 +175,31 @@ class Group:
 
     # -- membership ----------------------------------------------------------
 
+    def set_broker_name(self, name: str):
+        """Point future pings at a different broker peer (reference:
+        Group::setBrokerName, src/moolib.cc:2256). Resets the ping gate: a
+        ping still in flight to a dead broker would otherwise block the
+        first ping to the new one for the full RPC timeout — far longer
+        than the membership expiry this failover exists to beat."""
+        self.broker_name = str(name)
+        self._ping_inflight = False
+        self._last_ping = 0.0
+
+    def set_timeout(self, seconds: float):
+        """Collective/membership timeout (reference: Group::setTimeout,
+        src/moolib.cc:2257)."""
+        self.timeout = float(seconds)
+
+    def set_sort_order(self, order: int):
+        """Member-list sort priority carried with pings — lower sorts
+        first, influencing rank/tree position (reference:
+        Group::setSortOrder, src/moolib.cc:2258)."""
+        self.sort_order = int(order)
+
+    def name(self) -> str:
+        """Group name (reference: Group::name, src/moolib.cc:2261)."""
+        return self.group_name
+
     @property
     def sync_id(self) -> Optional[str]:
         return self._sync_id

@@ -389,3 +389,42 @@ def test_randomized_churn_allreduce_property(cluster):
         assert any(m == n_final for m, _ in rows), (
             f"peer {i} never succeeded at full membership"
         )
+
+
+def test_group_setter_surface(cluster):
+    """Reference binding parity: set_broker_name / set_timeout /
+    set_sort_order / name (src/moolib.cc:2256-2261). sort_order reorders
+    the member list (and therefore tree rank) at the next resync."""
+    import numpy as np
+
+    r0, g0 = cluster.spawn("alpha")
+    cluster.wait_members("g", 1)  # alpha registers first: creation order
+    r1, g1 = cluster.spawn("beta")
+    cluster.wait_members("g", 2)
+    assert g0.name() == "g"
+    # Default order is (sort_order, creation_order): alpha joined first.
+    assert g0.members == ["alpha", "beta"]
+
+    g1.set_sort_order(-1)  # beta should sort first after the next resync
+    g1.set_timeout(7.5)
+    assert g1.timeout == 7.5
+    # The changed order rides beta's next ping and itself triggers a fresh
+    # epoch — no unrelated membership change needed.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        for _, g in cluster.clients:
+            g.update()
+        if g0.members and g0.members[0] == "beta" and g1.members and (
+            g1.members[0] == "beta"
+        ):
+            break
+        time.sleep(0.05)
+    assert g0.members[0] == "beta", g0.members
+    cluster.spawn("gamma")
+    cluster.wait_members("g", 3)
+    assert g0.members[0] == "beta", g0.members
+    # Collectives still work under the reordered tree.
+    futs = [g.all_reduce("after", np.ones(2))
+            for _, g in cluster.clients]
+    for f in futs:
+        np.testing.assert_allclose(f.result(10), 3.0)
