@@ -156,6 +156,32 @@ class Batcher:
         with self._lock:
             return sum(1 for s in self._ready if s.done)
 
+    def size(self) -> int:
+        """Reference-surface alias for :meth:`ready` (reference:
+        BatcherWrapper::size, src/moolib.cc:1915 — 'size of the batched
+        queue')."""
+        return self.ready()
+
+    def __await__(self):
+        """Awaitable get(): ``await batcher`` yields the next completed
+        batch without blocking the event loop (reference: the Batcher is
+        awaitable with asyncio, BatcherWrapper::await, src/moolib.cc:1929).
+
+        Implemented as a cancel-safe non-blocking poll: a cancelled awaiter
+        consumes nothing and leaves no thread behind (a blocking ``get``
+        parked on an executor would survive cancellation, hang shutdown,
+        and steal the next batch from the caller's fallback path)."""
+        import asyncio
+
+        async def anext_batch():
+            while True:
+                try:
+                    return self.get(timeout=0)
+                except TimeoutError:
+                    await asyncio.sleep(0.005)
+
+        return anext_batch().__await__()
+
     def get(self, timeout: Optional[float] = None) -> Any:
         """Block until a completed batch is available and return it.
 

@@ -138,3 +138,53 @@ def test_cat_per_key_dims_overflow(rng):
             out["core_state"][0], allc[4 * i : 4 * (i + 1)]
         )
     assert batcher.empty()
+
+
+def test_batcher_awaitable_and_size():
+    """Reference-surface parity: the Batcher is awaitable with asyncio
+    (await yields the next completed batch) and size() reports the ready
+    queue depth (reference: src/moolib.cc:1915,1929)."""
+    import asyncio
+    import threading
+
+    b = Batcher(batch_size=2)
+    assert b.size() == 0
+
+    async def consume():
+        # Producer fills from a thread while the event loop awaits.
+        def produce():
+            for i in range(4):
+                b.stack({"x": np.full(3, float(i))})
+
+        threading.Thread(target=produce, daemon=True).start()
+        first = await b
+        second = await b
+        return first, second
+
+    first, second = asyncio.run(consume())
+    np.testing.assert_allclose(first["x"][0], 0.0)
+    np.testing.assert_allclose(second["x"][1], 3.0)
+    assert b.size() == 0
+
+
+def test_batcher_await_cancellation_consumes_nothing():
+    """A timed-out/cancelled awaiter must not steal a later batch or leave
+    a blocked thread behind."""
+    import asyncio
+
+    b = Batcher(batch_size=1)
+
+    async def cleaner():
+        try:
+            await asyncio.wait_for(asyncio.ensure_future(_awaiter()), 0.05)
+        except asyncio.TimeoutError:
+            pass
+        # The cancelled awaiter consumed nothing: the next batch goes to us.
+        b.stack({"x": np.ones(2)})
+        out = b.get(timeout=2)
+        np.testing.assert_allclose(out["x"][0], 1.0)
+
+    async def _awaiter():
+        return await b
+
+    asyncio.run(cleaner())
