@@ -63,6 +63,7 @@ class Batcher:
         self._pending_cat_rows = 0
         self._ready: deque = deque()  # completed (host-side) batches
         self._closed = False
+        self._async_waiters: list = []  # (loop, asyncio.Event) for __await__
 
     # -- producer side ------------------------------------------------------
 
@@ -167,18 +168,29 @@ class Batcher:
         batch without blocking the event loop (reference: the Batcher is
         awaitable with asyncio, BatcherWrapper::await, src/moolib.cc:1929).
 
-        Implemented as a cancel-safe non-blocking poll: a cancelled awaiter
-        consumes nothing and leaves no thread behind (a blocking ``get``
-        parked on an executor would survive cancellation, hang shutdown,
-        and steal the next batch from the caller's fallback path)."""
+        Event-driven and cancel-safe: the awaiter registers an
+        asyncio.Event that producers set via call_soon_threadsafe (the
+        Queue.get_async pattern) — no idle wakeups, no added delivery
+        latency, and a cancelled awaiter consumes nothing (a blocking
+        ``get`` parked on an executor would survive cancellation, hang
+        shutdown, and steal the next batch from the caller's fallback
+        path)."""
         import asyncio
 
         async def anext_batch():
+            loop = asyncio.get_running_loop()
             while True:
-                try:
-                    return self.get(timeout=0)
-                except TimeoutError:
-                    await asyncio.sleep(0.005)
+                event = asyncio.Event()
+                with self._lock:
+                    if self._ready and self._ready[0].done:
+                        batch = self._ready.popleft().batch
+                        # Wake producers parked in wait_below.
+                        self._lock.notify_all()
+                        return batch
+                    if self._closed:
+                        raise RuntimeError("Batcher is closed")
+                    self._async_waiters.append((loop, event))
+                await event.wait()
 
         return anext_batch().__await__()
 
@@ -217,6 +229,12 @@ class Batcher:
         with self._lock:
             self._closed = True
             self._lock.notify_all()
+            waiters, self._async_waiters = self._async_waiters, []
+        for loop, event in waiters:
+            try:
+                loop.call_soon_threadsafe(event.set)
+            except RuntimeError:
+                pass
 
     # -- internals ----------------------------------------------------------
 
@@ -270,6 +288,12 @@ class Batcher:
             slot.batch = batch
             slot.done = True
             self._lock.notify_all()
+            waiters, self._async_waiters = self._async_waiters, []
+        for loop, event in waiters:
+            try:
+                loop.call_soon_threadsafe(event.set)
+            except RuntimeError:
+                pass  # waiter's loop already closed
 
     def _stage(self, batch: Any) -> Any:
         """Dispatch H2D staging at batch-completion time (producer side), so

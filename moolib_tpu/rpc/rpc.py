@@ -169,17 +169,27 @@ class RpcDeferredReturn:
 
 
 class Queue:
-    """Awaitable call queue from ``define_queue`` (reference:
-    src/moolib.cc:433-576 — yields (return_cb, args, kwargs); optionally
-    coalesces up to batch_size waiting calls per get)."""
+    """Awaitable call queue (reference: src/moolib.cc:433-576,1936-1948).
 
-    def __init__(self, rpc: "Rpc", name: str, batch_size: Optional[int],
-                 dynamic_batching: bool, timeout: Callable[[], float]):
+    Two ways to fill it, mirroring the reference: ``define_queue`` pushes
+    RPC calls (yields ``(return_cb, args, kwargs)``, optionally coalescing
+    up to batch_size waiting calls per get), or construct one standalone
+    (``moolib_tpu.Queue()``) and ``enqueue`` items locally — awaiting then
+    yields each item as enqueued."""
+
+    _RAW = object()  # marks locally-enqueued entries (yielded verbatim)
+
+    def __init__(self, rpc: Optional["Rpc"] = None, name: str = "",
+                 batch_size: Optional[int] = None,
+                 dynamic_batching: bool = False,
+                 timeout: Optional[Callable[[], float]] = None):
         self._rpc = rpc
         self.name = name
         self.batch_size = batch_size
         self.dynamic_batching = dynamic_batching
-        self._timeout = timeout
+        # Standalone queues have no RPC deadline to honor: entries keep
+        # forever (a finite default would silently drop old items).
+        self._timeout = timeout or (lambda: float("inf"))
         self._cond = threading.Condition()
         self._entries: deque = deque()  # (expiry, return_cb, args, kwargs)
         self._closed = False
@@ -194,6 +204,16 @@ class Queue:
             waiters, self._async_waiters = self._async_waiters, []
         for loop, event in waiters:
             loop.call_soon_threadsafe(event.set)
+
+    def enqueue(self, item: Any):
+        """Add a local item; a get/await yields it verbatim (reference:
+        QueueWrapper::enqueue, src/moolib.cc:1941). Only for non-batched
+        queues — coalescing is defined over RPC call triples."""
+        if self.batch_size is not None:
+            raise RpcError(
+                "enqueue() is only supported on non-batched queues"
+            )
+        self._push(self._RAW, item, None)
 
     def _pop_locked(self):
         """Drop expired entries, then pop up to batch_size live ones."""
@@ -218,6 +238,8 @@ class Queue:
 
         if self.batch_size is None:
             _, cb, args, kwargs = popped[0]
+            if cb is self._RAW:
+                return args  # locally enqueued item, yielded verbatim
             return cb, args, kwargs
         cbs = [p[1] for p in popped]
         argss = [p[2] for p in popped]
@@ -282,6 +304,11 @@ class Queue:
 
     async def __anext__(self):
         return await self.get_async()
+
+    def __await__(self):
+        """``await queue`` -> next entry (reference: QueueWrapper::await,
+        src/moolib.cc:1947)."""
+        return self.get_async().__await__()
 
     def _close(self):
         with self._cond:

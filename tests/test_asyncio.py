@@ -125,3 +125,28 @@ def test_allreduce_from_coroutine():
         np.testing.assert_allclose(r1, r0)
     finally:
         c.close()
+
+
+def test_standalone_queue_enqueue_await():
+    """Reference-surface parity: a Queue constructed standalone accepts
+    local enqueue() and awaiting yields items verbatim (reference:
+    src/moolib.cc:1936-1948 — py::init<>, enqueue, __await__)."""
+    import moolib_tpu
+
+    q = moolib_tpu.Queue()
+
+    async def main():
+        q.enqueue({"a": 1})
+        q.enqueue("second")
+        first = await q
+        second = await q
+        return first, second
+
+    first, second = asyncio.run(main())
+    assert first == {"a": 1}
+    assert second == "second"
+
+    # Batched queues reject local enqueue (coalescing is RPC-triple-shaped).
+    qb = moolib_tpu.Queue(batch_size=4)
+    with pytest.raises(Exception, match="non-batched"):
+        qb.enqueue(1)
