@@ -126,7 +126,7 @@ def a2c_loss(params, apply_fn, batch, config):
 
 def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
     """Train A2C on CartPole; returns the list of logged stat rows."""
-    from moolib_tpu.utils import ensure_platforms
+    from moolib_tpu.utils import ensure_platforms, stage_host_async
 
     ensure_platforms()  # JAX_PLATFORMS=cpu must never touch a TPU tunnel
     import jax
@@ -188,7 +188,10 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
         reward_clip=0.0,
     )
     act = make_act_step(net.apply)
-    grad_step = make_grad_step(net.apply, config=loss_cfg, loss_fn=a2c_loss)
+    grad_step = make_grad_step(
+        net.apply, config=loss_cfg, loss_fn=a2c_loss,
+        grad_scale=float(cfg.batch_size),
+    )
     apply_step = make_apply_step(optimizer, donate=False)
 
     def get_state():
@@ -238,6 +241,16 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
         np.zeros(cfg.batch_size, np.int64) for _ in range(cfg.num_batches)
     ]
     pending_unrolls: List[dict] = []
+    # Device-resident metrics drained in bulk at log boundaries — no
+    # blocking per-update float() on the training thread (VERDICT r4 #2).
+    pending_metrics: List[dict] = []
+
+    def drain_metrics(keep_last: int = 0):
+        while len(pending_metrics) > keep_last:
+            m = pending_metrics.pop(0)
+            stats["total_loss"] += float(m["total_loss"])
+            stats["entropy"] += float(m["entropy"])
+
     env_steps = 0
     next_log = cfg.log_interval_steps
     futures = [pool.step(i, actions[i]) for i in range(cfg.num_batches)]
@@ -280,15 +293,21 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
                             for k, v in unroll.items()
                         }
                         grads, metrics = grad_step(state.params, batch)
-                        stats["total_loss"] += float(metrics["total_loss"])
-                        stats["entropy"] += float(metrics["entropy"])
-                        # grad_step returns batch-mean grads; the Accumulator
-                        # contract is batch-sum (src/accumulator.cc:880-1003).
-                        b = cfg.batch_size
-                        grad_sum = jax.tree_util.tree_map(
-                            lambda g: np.asarray(g) * b, grads
+                        # Defer the host readback (same as the vtrace loop):
+                        # a float() here would block on device execution
+                        # before reduce_gradients could even stage the
+                        # async D2H.
+                        pending_metrics.append(stage_host_async(metrics))
+                        if len(pending_metrics) >= 64:
+                            # Bound the backlog; all but the newest have had
+                            # >=1 update of transfer time.
+                            drain_metrics(keep_last=1)
+                        # grad_scale already turned batch-mean grads into
+                        # the batch-sum contribution inside the jit
+                        # (Accumulator contract: src/accumulator.cc:880-1003).
+                        accumulator.reduce_gradients(
+                            grads, batch_size=cfg.batch_size
                         )
-                        accumulator.reduce_gradients(grad_sum, batch_size=b)
                     else:
                         accumulator.skip_gradients()
                         stats["skips"] += 1
@@ -307,6 +326,7 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
 
             if env_steps >= next_log:
                 next_log += cfg.log_interval_steps
+                drain_metrics()
                 row = dict(stats.results(), env_steps=env_steps,
                            model_version=accumulator.model_version)
                 logs.append(row)

@@ -147,7 +147,7 @@ def _make_model(cfg: VtraceConfig):
 
 
 def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
-    from moolib_tpu.utils import ensure_platforms
+    from moolib_tpu.utils import ensure_platforms, stage_host_async
 
     ensure_platforms()  # JAX_PLATFORMS=cpu must never touch a TPU tunnel
     import jax
@@ -236,7 +236,14 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
             )
             return out, st, moe_aux_losses(inter)
 
-    grad_step = make_grad_step(learn_apply, config=loss_cfg, mesh=mesh)
+    # grad_scale folds the x batch_size "sum contribution" scaling into the
+    # jitted step, so the update loop never touches gradient values on the
+    # host (VERDICT r4 #2; reference keeps this off the training thread via
+    # async pinned copies, src/accumulator.cc:941-980).
+    grad_step = make_grad_step(
+        learn_apply, config=loss_cfg, mesh=mesh,
+        grad_scale=float(cfg.learn_batch_size),
+    )
     apply_step = make_apply_step(optimizer, donate=False)
 
     # --- elasticity / persistence ------------------------------------------
@@ -348,6 +355,23 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
     max_ready_batches = 4  # backpressure: drop rollouts past this backlog
 
     env_steps = 0
+    # Device-resident training metrics awaiting host readback: drained in
+    # bulk at log boundaries (and bounded below) instead of a blocking
+    # float() per update — the per-update host-sync stall VERDICT r4 #2
+    # measured. By drain time the async copies have long completed.
+    pending_metrics: list = []
+
+    def drain_metrics(keep_last: int = 0):
+        while len(pending_metrics) > keep_last:
+            m = pending_metrics.pop(0)
+            window["total_loss"] += float(m["total_loss"])
+            window["entropy"] += float(m["entropy"])
+            window["grad_norm"] += float(m["grad_norm"])
+            if "moe_drop_fraction" in m:
+                # Capacity drops must be visible in the logs, not
+                # silently eaten by the residual path.
+                window["moe_drop_fraction"] += float(m["moe_drop_fraction"])
+
     next_log = cfg.log_interval_steps
     last_stats_enqueue = 0.0
     t_start = time.monotonic()
@@ -411,20 +435,21 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                         if mesh is not None:
                             batch = shard_batch(mesh, batch)
                         grads, metrics = grad_step(state.params, batch)
-                        window["total_loss"] += float(metrics["total_loss"])
-                        window["entropy"] += float(metrics["entropy"])
-                        window["grad_norm"] += float(metrics["grad_norm"])
-                        if "moe_drop_fraction" in metrics:
-                            # Capacity drops must be visible in the logs,
-                            # not silently eaten by the residual path.
-                            window["moe_drop_fraction"] += float(
-                                metrics["moe_drop_fraction"]
-                            )
-                        b = cfg.learn_batch_size
-                        grad_sum = jax.tree_util.tree_map(
-                            lambda g: np.asarray(g) * b, grads
+                        # No host sync between grad_step dispatch and
+                        # reduce_gradients return (VERDICT r4 #2): metrics
+                        # stay on device (async-staged, drained at the next
+                        # log boundary) and grads are already batch-sum
+                        # scaled inside the jit; reduce_gradients stages
+                        # them with copy_to_host_async and defers the numpy
+                        # conversion to an RPC completion thread.
+                        pending_metrics.append(stage_host_async(metrics))
+                        if len(pending_metrics) >= 64:
+                            # Bound the backlog; everything but the newest
+                            # entry has had >=1 update of transfer time.
+                            drain_metrics(keep_last=1)
+                        accumulator.reduce_gradients(
+                            grads, batch_size=cfg.learn_batch_size
                         )
-                        accumulator.reduce_gradients(grad_sum, batch_size=b)
                     else:
                         accumulator.skip_gradients()
                         stats["skips"] += 1
@@ -458,6 +483,7 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                 )
             if env_steps >= next_log:
                 next_log += cfg.log_interval_steps
+                drain_metrics()
                 t_mark, s_mark = last_sps_mark
                 window["sps"].add((env_steps - s_mark) / (now - t_mark + 1e-9))
                 last_sps_mark = (now, env_steps)

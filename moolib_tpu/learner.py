@@ -247,6 +247,7 @@ def make_grad_step(
     axis_name: str = "dp",
     loss_fn: Callable = impala_loss,
     batch_axes: Optional[dict] = None,
+    grad_scale: Optional[float] = None,
 ) -> Callable[[Any, dict], Tuple[Any, dict]]:
     """Build the jitted gradient step ``(params, batch) -> (grads, metrics)``.
 
@@ -256,10 +257,28 @@ def make_grad_step(
     examples/vtrace/experiment.py:470-529), so grads must surface to the
     host. With a ``mesh`` the local dp-mean rides ICI inside the step; the
     Accumulator then handles the cross-cohort (DCN) reduction.
+
+    ``grad_scale`` multiplies the gradients INSIDE the jitted step
+    (typically by the local batch size, turning batch-mean grads into the
+    batch-sum contribution the Accumulator's count/reduce protocol wants).
+    Folding the scale in here means the host never touches gradient values
+    on the update path — the reference keeps this off the training thread
+    with async pinned-memory copies (reference: src/accumulator.cc:941-980);
+    our equivalent is on-device scaling + ``copy_to_host_async`` staging in
+    ``Accumulator.reduce_gradients``.
     """
 
     def local_loss(params, batch):
         return loss_fn(params, apply_fn, batch, config)
+
+    def finish(grads, metrics):
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        if grad_scale is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: g * grad_scale, grads
+            )
+        return grads, metrics
 
     if mesh is None:
 
@@ -267,9 +286,7 @@ def make_grad_step(
             (_, metrics), grads = jax.value_and_grad(
                 local_loss, has_aux=True
             )(params, batch)
-            metrics = dict(metrics)
-            metrics["grad_norm"] = optax.global_norm(grads)
-            return grads, metrics
+            return finish(grads, metrics)
 
         return jax.jit(step)
 
@@ -284,9 +301,7 @@ def make_grad_step(
             metrics = jax.tree_util.tree_map(
                 lambda m: jax.lax.pmean(m, axis_name), metrics
             )
-            metrics = dict(metrics)
-            metrics["grad_norm"] = optax.global_norm(grads)
-            return grads, metrics
+            return finish(grads, metrics)
 
         return jax.shard_map(
             inner,

@@ -58,7 +58,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
-from ..utils import get_logger, nest
+# stage_host_async: the shared staging idiom — the training thread stages
+# and returns; the numpy conversion happens on an RPC completion thread
+# once the count round resolves (the TPU equivalent of the reference's
+# async pinned-memory copies, src/accumulator.cc:941-980).
+from ..utils import get_logger, nest, stage_host_async as _stage_host_async
 from ..rpc.group import Group
 from ..rpc.rpc import Rpc, RpcError
 
@@ -69,6 +73,37 @@ __all__ = ["Accumulator"]
 
 def _to_numpy_tree(tree):
     return nest.map_structure(np.asarray, tree)
+
+
+
+
+def _materialize_parts(parts):
+    """Convert staged contribution trees to numpy and sum them (None for
+    an empty list). Runs OFF the training thread, after the async D2H
+    staged in :func:`_stage_host_async` has had a round-trip to finish."""
+    out = None
+    for p in parts:
+        out = _tree_add(out, _to_numpy_tree(p))
+    return out
+
+
+def _tree_is_ready(tree) -> bool:
+    """True when converting ``tree`` to numpy would not block: every device
+    leaf reports is_ready (numpy leaves trivially qualify). Non-blocking."""
+    for leaf in nest.flatten(tree):
+        ready = getattr(leaf, "is_ready", None)
+        if ready is None:
+            if hasattr(leaf, "copy_to_host_async"):
+                # A device array we cannot query: assume in flight (the
+                # conservative answer keeps this check non-blocking).
+                return False
+            continue
+        try:
+            if not ready():
+                return False
+        except Exception:
+            return False
+    return True
 
 
 def _tree_add(a, b):
@@ -100,9 +135,16 @@ class _LeafSpec:
         self.dtype = dtype
 
 
+def _leaf_dtype(x):
+    # Attribute first: np.asarray on a jax array is a blocking D2H wait,
+    # which the reduce_gradients fast path must never do.
+    dt = getattr(x, "dtype", None)
+    return np.dtype(dt) if dt is not None else np.asarray(x).dtype
+
+
 def _bundle_spec(tree):
     return nest.map_structure(
-        lambda x: _LeafSpec(np.shape(x), np.asarray(x).dtype), tree
+        lambda x: _LeafSpec(np.shape(x), _leaf_dtype(x)), tree
     )
 
 
@@ -194,7 +236,12 @@ class Accumulator:
         self._last_broadcast = time.monotonic()
         self._applying_push = False  # pauses result release during a push
 
-        self._pending_bundle = None              # user grads since last round
+        # User grad contributions since the last count round. Kept as a
+        # LIST of unconverted (possibly still-on-device) trees: the sum and
+        # the numpy conversion are deferred to an RPC completion thread
+        # (_materialize_parts), so reduce_gradients never blocks the
+        # training thread on a device transfer.
+        self._pending_parts: list = []
         self._pending_bs = 0
         self._pending_ngrads = 0
         # Bundle shape/dtype spec — once known, gradient rounds negotiate
@@ -309,9 +356,28 @@ class Accumulator:
         (reference: reduceImpl, src/accumulator.cc:880-1003)."""
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
-        tree = _to_numpy_tree(grads)
+        # Non-blocking: start the D2H transfers, convert later off-thread.
+        tree = _stage_host_async(grads)
         with self._lock:
-            self._pending_bundle = _tree_add(self._pending_bundle, tree)
+            # Opportunistic compaction BOUNDS device-memory retention in
+            # the steady state: older parts whose async transfers have
+            # completed (is_ready — a non-blocking check) fold into one
+            # host-numpy bundle, releasing their device buffers, so the
+            # pending list pins at most ~2 device trees (the newest, plus
+            # any still in flight) regardless of how slow a DCN count
+            # round is. The old eager path freed device memory instantly
+            # but blocked the training thread to do it.
+            if len(self._pending_parts) >= 2:
+                done_parts = []
+                while self._pending_parts and _tree_is_ready(
+                    self._pending_parts[0]
+                ):
+                    done_parts.append(self._pending_parts.pop(0))
+                if done_parts:
+                    self._pending_parts.insert(
+                        0, _materialize_parts(done_parts)
+                    )
+            self._pending_parts.append(tree)
             self._pending_bs += int(batch_size)
             self._pending_ngrads += 1
             self._user_has_contributed = True
@@ -377,9 +443,8 @@ class Accumulator:
         # Pending user grads survive a resync; committed ones were bound to
         # the old epoch's (now discarded) counts and merge back into pending
         # so they are re-counted and re-reduced in the new epoch.
-        self._pending_bundle = _tree_add(
-            self._committed_bundle, self._pending_bundle
-        )
+        if self._committed_bundle is not None:
+            self._pending_parts.insert(0, self._committed_bundle)
         self._pending_bs += self._committed_bs
         self._pending_ngrads += self._committed_ngrads
         self._committed_bundle = None
@@ -568,27 +633,48 @@ class Accumulator:
             self._synced
             and len(self._results) + self._grads_inflight < self._parallel
         ):
-            snap_bundle = self._pending_bundle
+            snap_parts = self._pending_parts
             snap_bs = self._pending_bs
             snap_ng = self._pending_ngrads
-            self._pending_bundle = None
+            self._pending_parts = []
             self._pending_bs = 0
             self._pending_ngrads = 0
         else:
-            snap_bundle, snap_bs, snap_ng = None, 0, 0
+            snap_parts, snap_bs, snap_ng = [], 0, 0
         self._round_inflight = True
 
         def restore_snapshot_locked():
-            self._pending_bundle = _tree_add(snap_bundle, self._pending_bundle)
+            # snap_parts holds either the raw staged trees or, post-
+            # materialization, the single summed numpy bundle — both
+            # re-enter the pending list unchanged (order preserved: the
+            # snapshot predates anything contributed since).
+            self._pending_parts = snap_parts + self._pending_parts
             self._pending_bs += snap_bs
             self._pending_ngrads += snap_ng
 
         def done(fut):
+            nonlocal snap_parts
             try:
                 total_bs, total_ng, all_templ, eff_vbs = fut.result(
                     timeout=0
                 )
             except Exception:
+                # Compact the snapshot to ONE host-numpy bundle before
+                # restoring (off the training thread, outside the lock):
+                # repeated count-round failures re-open wants_gradients
+                # each retry, and an uncompacted backlog would retain one
+                # full device-resident gradient tree per retry — an HBM
+                # leak the old eager-numpy path never had. Compaction
+                # failure (dead device tunnel) keeps the raw parts and
+                # retries later — it must never abort before the locked
+                # bookkeeping below, which would wedge _round_inflight
+                # forever (callback exceptions are swallowed upstream).
+                if snap_parts:
+                    try:
+                        snap_parts = [_materialize_parts(snap_parts)]
+                    except Exception as e:
+                        log.error("gradient compaction failed "
+                                  "(kept staged): %s", e)
                 with self._lock:
                     restore_snapshot_locked()
                     if self._epoch == epoch:
@@ -600,6 +686,32 @@ class Accumulator:
                         # wants_gradients window for the retry.
                         self._user_has_contributed = False
                 return
+            # The count succeeded: materialize + sum the staged device
+            # trees HERE — on the RPC completion thread, outside the lock.
+            # This is where the deferred D2H from reduce_gradients actually
+            # lands; by now the async transfers have had a full count-round
+            # RTT to complete, so this is normally a wait-free fetch.
+            #
+            # Materialization failure (device died between dispatch and
+            # readback) must not abort this callback: the cluster already
+            # counted our batch contribution, so the round proceeds with
+            # our bundle DROPPED (the same semantics as a peer dying
+            # mid-round, which the elastic protocol tolerates) — silently
+            # wedging _round_inflight would stall the whole cohort.
+            if snap_parts:
+                try:
+                    snap_parts = [_materialize_parts(snap_parts)]
+                except Exception as e:
+                    nonlocal snap_bs, snap_ng
+                    log.error(
+                        "gradient readback failed; dropping %d staged "
+                        "contribution(s) from this round: %s",
+                        snap_ng, e,
+                    )
+                    snap_parts = []
+                    snap_bs = 0
+                    snap_ng = 0
+            snap_bundle = snap_parts[0] if snap_parts else None
             with self._lock:
                 if self._epoch != epoch:
                     # Success for a dead epoch: counts were discarded by the

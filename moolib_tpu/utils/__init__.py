@@ -24,7 +24,32 @@ __all__ = [
     "Checkpointer",
     "save_checkpoint",
     "load_checkpoint",
+    "stage_host_async",
 ]
+
+
+def stage_host_async(tree):
+    """Start (but do not wait for) D2H transfer of every device leaf.
+
+    ``jax.Array.copy_to_host_async`` kicks off the transfer and caches the
+    result, so a later host conversion of the same array is a wait-free
+    (or nearly so) fetch. The ONE shared implementation of this idiom —
+    the Accumulator stages gradient bundles with it and the examples stage
+    per-update metrics (the reference's analogue is async pinned-memory
+    copies, reference: src/accumulator.cc:941-980). Non-device leaves pass
+    through untouched; returns the tree unchanged for chaining."""
+    from . import nest
+
+    def stage(x):
+        start = getattr(x, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:  # non-jax array-likes with the attr
+                pass
+        return x
+
+    return nest.map_structure(stage, tree)
 
 
 def __getattr__(name: str):

@@ -72,6 +72,64 @@ def test_gradient_reduction_virtual_batch(cluster):
     assert v0 >= 1
 
 
+class _FakeDeviceLeaf:
+    """Instrumented jax.Array stand-in: records when the async D2H stage
+    starts and when (and on which thread) the blocking numpy conversion
+    actually happens."""
+
+    def __init__(self, arr):
+        self._arr = np.asarray(arr)
+        self.staged = 0
+        self.converted_on = []  # thread names of __array__ calls
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    def copy_to_host_async(self):
+        self.staged += 1
+
+    def __array__(self, dtype=None, copy=None):
+        self.converted_on.append(threading.current_thread().name)
+        return self._arr if dtype is None else self._arr.astype(dtype)
+
+
+def test_reduce_gradients_never_blocks_on_device_transfer(cluster):
+    """VERDICT r4 #2: reduce_gradients must stage the device->host copy
+    asynchronously and return WITHOUT converting (= without any blocking
+    transfer); the numpy materialization happens later, off the calling
+    thread, once the count round resolves."""
+    accs = [_spawn_acc(cluster, f"p{i}", vbs=4) for i in range(2)]
+    _pump(accs, lambda: all(
+        a.connected() and a.wants_gradients() for a in accs
+    ))
+    leaves = [
+        _FakeDeviceLeaf(np.full((3,), float(i + 1) * 2)) for i in range(2)
+    ]
+    for a, leaf in zip(accs, leaves):
+        a.reduce_gradients({"w": leaf}, batch_size=2)
+        # The contract under test: async stage started, NO conversion yet.
+        assert leaf.staged == 1
+        assert leaf.converted_on == [], (
+            "reduce_gradients blocked on a device transfer"
+        )
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+    main = threading.current_thread().name
+    for a, leaf in zip(accs, leaves):
+        mean, count = a.result_gradients()
+        assert count == 4
+        np.testing.assert_allclose(mean["w"], np.full((3,), (2 + 4) / 4))
+        # Materialization happened exactly once, off the training thread
+        # (the _pump loop calling update() is this test's training thread).
+        assert leaf.converted_on and all(
+            t != main for t in leaf.converted_on
+        ), leaf.converted_on
+
+
 def test_accumulation_across_rounds(cluster):
     """vbs larger than one round's contributions: counts accumulate."""
     accs = [_spawn_acc(cluster, f"p{i}", vbs=8) for i in range(2)]
