@@ -61,6 +61,7 @@ from .mesh import pvary_if_needed
 
 __all__ = [
     "pipeline_apply",
+    "pipeline_train_1f1b",
     "stack_stage_params",
     "shard_microbatches",
     "unshard_microbatches",
@@ -189,3 +190,188 @@ def pipeline_apply(
         out, axis_name, [(d, (d + 1) % n_stages) for d in range(n_stages)]
     )
     return out[:, None] if squeeze else out
+
+def pipeline_train_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Any,
+    microbatches: jax.Array,
+    axis_name: str = "pp",
+):
+    """Scheduled 1F1B training pipeline: warmup / steady one-forward-
+    one-backward / drain, with explicit per-stage backward and weight-grad
+    accumulation. Call INSIDE shard_map.
+
+    Where :func:`pipeline_apply` + ``jax.grad`` differentiates through the
+    whole pipeline scan (GPipe: all forwards, then all backwards — the scan
+    stashes every tick's carry for the backward, O(ticks * carry) memory
+    even under remat), 1F1B interleaves each microbatch's backward as soon
+    as its forward has drained past the last stage. The backward here is
+    EXPLICIT — per-tick ``jax.vjp`` of one stage application against a
+    stashed input — so autodiff never sees the scan and the stash is a
+    fixed ``pp``-slot ring per device: the 1F1B in-flight invariant (stage
+    ``d`` holds at most ``pp - d`` live activations) bounds it.
+
+    Schedule (sub-tick units; one tick = one F or one B per device; S =
+    pp stages, M microbatches, device d, microbatch m) — the lockstep
+    just-in-time variant of PipeDream-flush:
+
+    - forward:   t = d + 2m           (even (t - d) phase)
+    - backward:  t = 2S - 1 - d + 2m  (odd (t - d) phase)
+
+    Dependencies hold by construction: F(d,m) is exactly one tick after
+    F(d-1,m) and B(d,m) exactly one tick after B(d+1,m), so a single
+    carry slot per direction is the whole communication buffer; the stash
+    slot ``m % S`` is freed (by B of ``m``) before F of ``m+S`` reuses it
+    (gap 2d+1 ticks); the per-device in-flight activation count never
+    exceeds S - d — the 1F1B invariant (eager-warmup 1F1B has the same
+    bound; just-in-time issue keeps the one-slot handoff of an SPMD
+    lockstep ring). Total ticks T = 2M + 2(S-1): the bubble is 2(S-1)
+    ticks, a fraction (S-1)/(M+S-1) — identical to GPipe's fill+drain,
+    because 1F1B's win is activation MEMORY, not bubble (interleaved/
+    looping schedules that also shrink the bubble are a further step, not
+    taken here).
+
+    Args:
+      stage_fn: ``(params, x_mb) -> y_mb``, activation shape preserved.
+      loss_fn: ``(y_mb) -> scalar`` applied to the LAST stage's output of
+        each microbatch; per-microbatch losses are summed.
+      stage_params: this device's stage slice (leading dim 1, from a
+        ``P('pp', ...)``-sharded :func:`stack_stage_params` stack).
+      microbatches: ``[M, mb, ...]`` REPLICATED across the pp axis (v1
+        trades the GPipe rotation trick's input sharding for schedule
+        clarity; inputs are one microbatch stream, small next to the
+        O(ticks)-carry stash this schedule eliminates).
+
+    Returns ``(loss_sum, stage_grads)`` — loss_sum replicated (psum), and
+    the weight-grad accumulation for THIS device's stage with leading dim
+    1 (``out_specs=P('pp', ...)`` re-stacks the pipeline).
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    S = n_stages
+    M = microbatches.shape[0]
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    def pv(x):
+        return pvary_if_needed(x, axis_name)
+
+    # Everything the tick body touches must be device-varying for
+    # shard_map's vma typing: the replicated input stream enters varying
+    # compute (each device indexes it with its own schedule).
+    microbatches = pv(microbatches)
+    act_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+    zeros_act = jnp.zeros(act_shape, dtype)
+    chain_fwd = [(d, d + 1) for d in range(S - 1)]
+    chain_bwd = [(d, d - 1) for d in range(1, S)]
+
+    carry0 = (
+        pv(zeros_act),                       # act_in: fwd hop payload
+        pv(zeros_act),                       # gy_in: bwd hop payload
+        pv(jnp.zeros((S,) + act_shape, dtype)),  # stash: S-slot input ring
+        pv(zeros_act),                       # pending_gy (last stage only)
+        pv(jnp.zeros((), jnp.float32)),      # loss accumulator
+        jax.tree_util.tree_map(
+            lambda p: pv(jnp.zeros_like(p)), params
+        ),                                   # weight-grad accumulation
+    )
+
+    def tick(carry, t):
+        act_in, gy_in, stash, pending_gy, loss_acc, gacc = carry
+
+        # -- schedule masks (device-local, data-dependent control flow) --
+        # Just-in-time forwards: F(d, m) at t = d + 2m, B(d, m) at
+        # t = 2S-1-d + 2m. Production is always exactly one tick before
+        # consumption on the neighbor (both directions), so one carry slot
+        # per direction suffices; F uses the even (t-d) phase, B the odd.
+        tf = t - idx
+        m_f = tf // 2
+        do_f = jnp.logical_and(
+            jnp.logical_and(tf >= 0, tf % 2 == 0), m_f < M
+        )
+        tb = t - (2 * S - 1 - idx)
+        m_b = tb // 2
+        do_b = jnp.logical_and(
+            jnp.logical_and(tb >= 0, tb % 2 == 0), m_b < M
+        )
+
+        # -- forward ------------------------------------------------------
+        mb_t = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(m_f, 0, M - 1), 0, keepdims=False
+        )
+        x = jnp.where(idx == 0, mb_t, act_in)
+        # False branches derive their zeros from the operands (x * 0) so
+        # both cond branches carry the same device-varying vma type.
+        y = jax.lax.cond(
+            do_f,
+            lambda x: stage_fn(params, x).astype(dtype),
+            lambda x: x * jnp.zeros((), dtype),
+            x,
+        )
+        stash = jnp.where(
+            do_f,
+            jax.lax.dynamic_update_index_in_dim(
+                stash, x.astype(dtype), jnp.clip(m_f, 0, M - 1) % S, 0
+            ),
+            stash,
+        )
+        # Last stage: per-microbatch loss value + dL/dy, kept for the very
+        # next tick's backward of the same microbatch.
+        is_last = idx == S - 1
+        def loss_and_grad(y):
+            lv, gy = jax.value_and_grad(loss_fn)(y)
+            # f32 accumulator regardless of activation/loss dtype (bf16
+            # torsos must not force a bf16 loss sum).
+            return lv.astype(jnp.float32), gy.astype(dtype)
+
+        lval, gy = jax.lax.cond(
+            jnp.logical_and(do_f, is_last),
+            loss_and_grad,
+            lambda y: (
+                jnp.sum(y).astype(jnp.float32) * 0.0,
+                y * jnp.zeros((), dtype),
+            ),
+            y,
+        )
+        loss_acc = loss_acc + lval
+        pending_gy = jnp.where(jnp.logical_and(do_f, is_last), gy,
+                               pending_gy)
+
+        # -- backward -----------------------------------------------------
+        x_saved = jax.lax.dynamic_index_in_dim(
+            stash, jnp.clip(m_b, 0, M - 1) % S, 0, keepdims=False
+        )
+        dy = jnp.where(is_last, pending_gy, gy_in)
+
+        def bwd(opnd):
+            x_saved, dy = opnd
+            _, vjp = jax.vjp(stage_fn, params, x_saved)
+            dparams, dx = vjp(dy.astype(dtype))
+            return dparams, dx.astype(dtype)
+
+        dp, dx = jax.lax.cond(
+            do_b,
+            bwd,
+            lambda opnd: (
+                jax.tree_util.tree_map(
+                    lambda p: p * jnp.zeros((), p.dtype), params
+                ),
+                opnd[0] * jnp.zeros((), dtype),
+            ),
+            (x_saved, dy),
+        )
+        gacc = jax.tree_util.tree_map(jnp.add, gacc, dp)
+
+        # -- hops ---------------------------------------------------------
+        act_next = jax.lax.ppermute(y, axis_name, chain_fwd)
+        gy_next = jax.lax.ppermute(dx, axis_name, chain_bwd)
+        return (act_next, gy_next, stash, pending_gy, loss_acc, gacc), None
+
+    T = 2 * M + 2 * (S - 1)
+    (_, _, _, _, loss_acc, gacc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(T)
+    )
+    loss_sum = jax.lax.psum(loss_acc, axis_name)
+    grads = jax.tree_util.tree_map(lambda g: g[None], gacc)
+    return loss_sum, grads

@@ -11,6 +11,7 @@ from moolib_tpu.parallel.moe import moe_ffn, moe_ffn_sharded, moe_params
 from moolib_tpu.parallel.pipeline import (
     MICRO_SPEC,
     pipeline_apply,
+    pipeline_train_1f1b,
     shard_microbatches,
     stack_stage_params,
     unshard_microbatches,
@@ -158,6 +159,101 @@ class TestPipeline:
         assert (
             mem_remat.temp_size_in_bytes < mem_plain.temp_size_in_bytes
         ), (mem_remat.temp_size_in_bytes, mem_plain.temp_size_in_bytes)
+
+    @pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 6), (4, 8)])
+    def test_1f1b_loss_and_gradients_match_sequential(
+        self, rng, n_stages, n_micro
+    ):
+        """VERDICT r4 #4: the scheduled 1F1B pipeline (explicit per-stage
+        backward + weight-grad accumulation) must produce the same loss and
+        the same stage gradients as plain autodiff of the sequential model
+        — including n_micro NOT divisible by pp (no GPipe divisibility
+        constraint)."""
+        F, mb = 6, 3
+        stages = _stages(rng, n_stages, F)
+        x = jnp.asarray(rng.standard_normal((n_micro, mb, F)), jnp.float32)
+        mesh = make_mesh(dp=1, pp=n_stages, devices=jax.devices()[:n_stages])
+        stacked = stack_stage_params(stages)
+
+        def mb_loss(y):
+            return jnp.sum(y**2)
+
+        def ref_loss(stacked, x):
+            y = x
+            for i in range(n_stages):
+                y = _stage_fn(
+                    jax.tree_util.tree_map(lambda p: p[i], stacked), y
+                )
+            return jnp.sum(y**2)
+
+        loss_ref, g_ref = jax.value_and_grad(ref_loss)(stacked, x)
+
+        loss_1f1b, g_1f1b = jax.jit(
+            jax.shard_map(
+                lambda p, x: pipeline_train_1f1b(
+                    _stage_fn, mb_loss, p, x, axis_name="pp"
+                ),
+                mesh=mesh,
+                in_specs=(P("pp"), P()),
+                out_specs=(P(), P("pp")),
+            )
+        )(stacked, x)
+
+        np.testing.assert_allclose(
+            float(loss_1f1b), float(loss_ref), rtol=2e-5
+        )
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_ref),
+            jax.tree_util.tree_leaves_with_path(g_1f1b),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5,
+                err_msg=str(pa),
+            )
+
+    def test_1f1b_peak_memory_leq_gpipe_remat(self, rng):
+        """VERDICT r4 #4 'done' bar: compiled temp (activation) memory of
+        the 1F1B training step at pp=4 must not exceed GPipe+remat's
+        autodiff-through-the-scan backward — 1F1B's stash is a fixed
+        pp-slot ring, while the scan stash grows O(ticks)."""
+        n_stages, mb, F = 4, 8, 32
+        n_micro = 16
+        stages = _stages(rng, n_stages, F)
+        x = jnp.asarray(
+            rng.standard_normal((n_micro, mb, F)), jnp.float32
+        )
+        mesh = make_mesh(dp=1, pp=n_stages, devices=jax.devices()[:4])
+        stacked = stack_stage_params(stages)
+
+        def mb_loss(y):
+            return jnp.sum(y**2)
+
+        mem_gpipe = (
+            jax.jit(jax.grad(_pipe_loss(mesh, n_stages, remat=True)))
+            .lower(stacked, x)
+            .compile()
+            .memory_analysis()
+        )
+        mem_1f1b = (
+            jax.jit(
+                jax.shard_map(
+                    lambda p, x: pipeline_train_1f1b(
+                        _stage_fn, mb_loss, p, x, axis_name="pp"
+                    ),
+                    mesh=mesh,
+                    in_specs=(P("pp"), P()),
+                    out_specs=(P(), P("pp")),
+                )
+            )
+            .lower(stacked, x)
+            .compile()
+            .memory_analysis()
+        )
+        if mem_gpipe is None or mem_1f1b is None:
+            pytest.skip("backend exposes no memory analysis")
+        assert (
+            mem_1f1b.temp_size_in_bytes <= mem_gpipe.temp_size_in_bytes
+        ), (mem_1f1b.temp_size_in_bytes, mem_gpipe.temp_size_in_bytes)
 
     def test_per_device_memory_scales_with_shard_not_stream(self, rng):
         """The point of sharded microbatches (VERDICT r3 #6): per-device
