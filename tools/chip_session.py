@@ -6,16 +6,22 @@ in a whole day. When it is, nothing should depend on a human typing the
 right five commands — this orchestrator probes until the tunnel answers
 (bounded), then runs, in priority order:
 
-1. bench.py                       -> PERF_r04.json      (headline steps/s)
-2. tools/perf_sweep.py            -> SWEEP_r04.json     (batch-size sweep)
-3. tools/attn_bench.py            -> ATTN_r04.json      (flash/Mosaic)
-4. bench_e2e.py                   -> E2E_r04.json       (acting+training)
+1. bench.py                       -> PERF_r{NN}.json    (headline steps/s)
+2. tools/perf_sweep.py            -> SWEEP_r{NN}.json   (batch/layout sweep
+                                     incl. the labeled mxu=1 variant)
+3. tools/attn_bench.py            -> ATTN_r{NN}.json    (flash/Mosaic)
+4. bench_e2e.py                   -> E2E_r{NN}.json     (acting+training)
 
 Each stage is a subprocess with its own timeout, so a tunnel that dies
 mid-session costs one stage, not the session; whatever completed is on
-disk. A session log (CHIP_SESSION_r04.json) records per-stage status.
+disk. A session log (CHIP_SESSION_r{NN}.json) records per-stage status.
 
-Usage: python tools/chip_session.py [--wait-budget 14400] [--round 4]
+``--rehearse`` fakes a tunnel window on CPU with shrunken workloads and is
+exercised end-to-end by tests/test_bench_tools.py, so the one live window
+cannot be wasted on a harness bug (VERDICT r4 #1).
+
+Usage: python tools/chip_session.py [--wait-budget 36000] [--round N]
+       [--out-dir DIR] [--rehearse]
 """
 
 from __future__ import annotations
@@ -71,15 +77,37 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--wait-budget", type=float, default=14400.0,
                     help="seconds to keep probing for a live tunnel")
-    ap.add_argument("--round", type=int, default=4)
+    ap.add_argument("--round", type=int, default=5)
     ap.add_argument("--skip-wait", action="store_true",
                     help="assume the device is reachable now")
+    ap.add_argument("--out-dir", default=REPO,
+                    help="directory artifacts are written into")
+    ap.add_argument(
+        "--rehearse", action="store_true",
+        help="CPU dry-rehearsal (VERDICT r4 #1): fake a tunnel window by "
+        "forcing JAX_PLATFORMS=cpu and shrinking every stage workload, so "
+        "the probe -> run -> incremental-artifact-write path is exercised "
+        "end to end without a chip. The one live window must not be the "
+        "first time this orchestration runs.",
+    )
     args = ap.parse_args()
     r = args.round
+    out = os.path.abspath(args.out_dir)
 
     log = {"round": r, "started": time.strftime("%Y-%m-%d %H:%M:%S"),
-           "stages": []}
+           "rehearsal": bool(args.rehearse), "stages": []}
 
+    if args.rehearse:
+        # CPU is always "reachable": the wait_for_device probe subprocess
+        # honors JAX_PLATFORMS=cpu, so the real probe path still runs.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # A rehearsal must fail FAST if the CPU probe is broken — honor a
+        # caller-set budget (the CI test sets 60s) and otherwise cap at
+        # 120s rather than inheriting the hours-long production budget.
+        args.wait_budget = min(
+            args.wait_budget,
+            float(os.environ.get("MOOLIB_BENCH_BUDGET", 120)),
+        )
     if not args.skip_wait:
         os.environ["MOOLIB_BENCH_BUDGET"] = str(args.wait_budget)
         from moolib_tpu.utils.benchmark import wait_for_device
@@ -92,14 +120,28 @@ def main():
     env["MOOLIB_BENCH_BUDGET"] = "300"  # stages re-probe briefly at most
     py = sys.executable
 
+    if args.rehearse:
+        env["MOOLIB_BENCH_BATCH"] = "4"
+        env["MOOLIB_BENCH_ITERS"] = "2"
+        sweep_args = ["B=4,dtype=f32", "B=4,dtype=f32,s2d=2"]
+        attn_args = ["--quick", "--budget", "60"]
+        e2e_secs, t_bench, t_sweep, t_attn, t_e2e = "20", 600, 600, 300, 420
+    else:
+        sweep_args = ["B=256,dtype=bf16", "B=512,dtype=bf16",
+                      "B=1024,dtype=bf16", "B=256,dtype=bf16,s2d=2",
+                      "B=256,dtype=bf16,mxu=1", "B=512,dtype=bf16,mxu=1"]
+        attn_args = ["--budget", "600"]
+        e2e_secs, t_bench, t_sweep, t_attn, t_e2e = "90", 900, 1800, 1200, 1200
+
     # 1. Headline learner bench (highest priority: the driver's metric).
-    e = run_stage("bench", [py, "bench.py"], 900, log, env)
+    e = run_stage("bench", [py, "bench.py"], t_bench, log, env)
     if e.get("tail_json") and e["tail_json"].get("value") is not None:
-        with open(os.path.join(REPO, f"PERF_r{r:02d}.json"), "w") as f:
+        with open(os.path.join(out, f"PERF_r{r:02d}.json"), "w") as f:
             json.dump(
                 {
                     "round": r,
                     "cmd": "python bench.py (via tools/chip_session.py)",
+                    "rehearsal": bool(args.rehearse),
                     "result": e["tail_json"],
                 },
                 f, indent=1,
@@ -107,19 +149,17 @@ def main():
 
     # 2. Batch-size sweep (the recorded-but-never-executed r3 item).
     e = run_stage(
-        "perf_sweep",
-        [py, "tools/perf_sweep.py", "B=256,dtype=bf16",
-         "B=512,dtype=bf16", "B=1024,dtype=bf16",
-         "B=256,dtype=bf16,s2d=2"],
-        1800, log, env,
+        "perf_sweep", [py, "tools/perf_sweep.py"] + sweep_args,
+        t_sweep, log, env,
     )
     if e.get("json_rows"):
-        with open(os.path.join(REPO, f"SWEEP_r{r:02d}.json"), "w") as f:
+        with open(os.path.join(out, f"SWEEP_r{r:02d}.json"), "w") as f:
             json.dump(
                 {
                     "round": r,
                     "cmd": "python tools/perf_sweep.py "
-                    "B={256,512,1024},dtype=bf16",
+                    + " ".join(sweep_args),
+                    "rehearsal": bool(args.rehearse),
                     "rows": e["json_rows"],
                     "wall_s": e["wall_s"],
                 },
@@ -129,26 +169,31 @@ def main():
     # 3. Attention backends + Mosaic validation.
     run_stage(
         "attn_bench",
-        [py, "tools/attn_bench.py", "--json", f"ATTN_r{r:02d}.json",
-         "--budget", "600"],
-        1200, log, env,
+        [py, "tools/attn_bench.py", "--json",
+         os.path.join(out, f"ATTN_r{r:02d}.json"), "--round", str(r)]
+        + attn_args,
+        t_attn, log, env,
     )
 
     # 4. End-to-end acting+training throughput.
-    e = run_stage("bench_e2e", [py, "bench_e2e.py", "90"], 1200, log, env)
+    e = run_stage(
+        "bench_e2e", [py, "bench_e2e.py", e2e_secs], t_e2e, log, env
+    )
     if e.get("tail_json") and e["tail_json"].get("value") is not None:
-        with open(os.path.join(REPO, f"E2E_r{r:02d}.json"), "w") as f:
+        with open(os.path.join(out, f"E2E_r{r:02d}.json"), "w") as f:
             json.dump(
                 {
                     "round": r,
-                    "cmd": "python bench_e2e.py 90 (via chip_session)",
+                    "cmd": f"python bench_e2e.py {e2e_secs} "
+                    "(via chip_session)",
+                    "rehearsal": bool(args.rehearse),
                     "result": e["tail_json"],
                 },
                 f, indent=1,
             )
 
     log["finished"] = time.strftime("%Y-%m-%d %H:%M:%S")
-    with open(os.path.join(REPO, f"CHIP_SESSION_r{r:02d}.json"), "w") as f:
+    with open(os.path.join(out, f"CHIP_SESSION_r{r:02d}.json"), "w") as f:
         json.dump(log, f, indent=1)
     ok = sum(1 for s in log["stages"] if s.get("rc") == 0)
     print(f"chip session done: {ok}/{len(log['stages'])} stages ok",
