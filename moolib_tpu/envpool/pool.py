@@ -284,21 +284,41 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
 
 
 class EnvStepperFuture:
-    """Future for one in-flight batched step (reference: src/env.cc:351-412)."""
+    """Future for one in-flight batched step (reference: src/env.cc:351-412).
+
+    The first ``result()`` collects from the shared buffer and CACHES the
+    outcome on this future: later calls (including from callbacks
+    registered after collection) return the step this future belongs to,
+    never a re-read of buffer state a newer step may have overwritten —
+    ``step()`` refuses to reuse a busy buffer, so by the time a newer step
+    exists this future has necessarily been collected.
+    """
 
     def __init__(self, pool: "EnvPool", batch_index: int, event: threading.Event):
         self._pool = pool
         self._batch_index = batch_index
         self._event = event
         self._has_callback = False
+        self._outcome = None  # ("ok", value) | ("error", exception)
 
     def result(self, timeout: Optional[float] = None):
+        if self._outcome is not None:
+            kind, value = self._outcome
+            if kind == "ok":
+                return value
+            raise value
         pool = self._pool
         if pool._ctrl is not None and not self._has_callback:
             pool._wait_native(self._batch_index, timeout)
         elif not self._event.wait(timeout):
             raise TimeoutError("EnvStepperFuture.result timed out")
-        return pool._collect(self._batch_index)
+        try:
+            out = pool._collect(self._batch_index)
+        except Exception as e:
+            self._outcome = ("error", e)
+            raise
+        self._outcome = ("ok", out)
+        return out
 
     def add_done_callback(self, fn) -> None:
         """Invoke ``fn(self)`` from the pool's completion thread once this
@@ -627,7 +647,14 @@ class EnvPool:
     def _add_done_callback(self, batch_index: int, fn, fut):
         fire_now = False
         with self._lock:
-            if self._waiter_error or self._closed:
+            if fut._outcome is not None:
+                # Already collected: fire with the CACHED outcome. Must be
+                # checked before the busy flag — a newer step may be in
+                # flight on this buffer, and registering there would fire
+                # this callback at the wrong time (with result() only safe
+                # because of the cache).
+                fire_now = True
+            elif self._waiter_error or self._closed:
                 fire_now = True
             elif not self._busy[batch_index]:
                 fire_now = True  # step already collected

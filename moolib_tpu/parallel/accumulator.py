@@ -155,7 +155,8 @@ def _grad_merge(a, b):
 
 
 def _count_merge(a, b):
-    """Merge (batch_size, n_grads, has_template, requested_vbs) tuples.
+    """Merge (batch_size, n_grads, has_template, requested_vbs,
+    chunk_bytes) tuples.
 
     The count result is identical on every peer (it is an allreduce), so
     it doubles as the NEGOTIATION channel for everything the following
@@ -172,9 +173,18 @@ def _count_merge(a, b):
       ``set_virtual_batch_size`` call racing in-flight count rounds can
       never make peers disagree about whether a round triggered (a purely
       local threshold could fire on one peer's completion and not
-      another's, silently desynchronizing gradient means)."""
-    (bsa, nga, ta, va), (bsb, ngb, tb, vb) = a, b
-    return (bsa + bsb, nga + ngb, ta and tb, max(va, vb))
+      another's, silently desynchronizing gradient means).
+    - ``chunk_bytes`` MINs across members: chunk geometry (sub-op keys +
+      boundaries) must be identical cluster-wide or every large reduce
+      stalls to timeout; negotiating it here means peers with mismatched
+      ``MOOLIB_TPU_ALLREDUCE_CHUNK`` settings — or a rolling upgrade that
+      changes the default — converge on the smallest value (0, i.e.
+      chunking-disabled anywhere, disables it everywhere) instead of
+      livelocking. NOTE the count tuple itself is a protocol surface:
+      peers must run the same framework version (tuple arity is not
+      negotiated)."""
+    (bsa, nga, ta, va, ca), (bsb, ngb, tb, vb, cb) = a, b
+    return (bsa + bsb, nga + ngb, ta and tb, max(va, vb), min(ca, cb))
 
 
 class Accumulator:
@@ -199,6 +209,7 @@ class Accumulator:
         timeout: float = 10.0,
         parallel_gradients: int = 1,
         state_broadcast_interval: Optional[float] = 600.0,
+        chunk_bytes: Optional[int] = None,
     ):
         # Validate BEFORE any side effect: creating the Group registers
         # service handlers on the rpc, which must not happen for a
@@ -254,6 +265,15 @@ class Accumulator:
         # build under the lock each time.
         self._zeros_bundle: Optional[Any] = None
         self._chunked_rounds = 0                 # observability/testing
+        # Local chunk-geometry preference, negotiated through the count
+        # round (min across members — see _count_merge) so heterogeneous
+        # env settings converge instead of stalling collectives.
+        from ..rpc.group import CHUNK_BYTES_DEFAULT
+
+        self._chunk_bytes = (
+            CHUNK_BYTES_DEFAULT if chunk_bytes is None else int(chunk_bytes)
+        )
+        self._neg_chunk: Optional[int] = None    # last negotiated value
         self._committed_bundle = None            # counted, awaiting grad round
         self._committed_bs = 0
         self._committed_ngrads = 0
@@ -655,9 +675,8 @@ class Accumulator:
         def done(fut):
             nonlocal snap_parts
             try:
-                total_bs, total_ng, all_templ, eff_vbs = fut.result(
-                    timeout=0
-                )
+                (total_bs, total_ng, all_templ, eff_vbs,
+                 neg_chunk) = fut.result(timeout=0)
             except Exception:
                 # Compact the snapshot to ONE host-numpy bundle before
                 # restoring (off the training thread, outside the lock):
@@ -737,16 +756,18 @@ class Accumulator:
                 # the same trigger decision and picks the same wire format
                 # — regardless of when a local set_virtual_batch_size call
                 # landed relative to this completion.
+                self._neg_chunk = neg_chunk
                 if eff_vbs <= self._cumulative_bs:
                     self._start_grad_round(
-                        self._cumulative_bs, chunked=bool(all_templ)
+                        self._cumulative_bs, chunked=bool(all_templ),
+                        chunk_bytes=neg_chunk,
                     )
 
         try:
             fut = self.group.all_reduce(
                 f"acc.count.{seq}.{self._attempt}",
                 (snap_bs, snap_ng, self._bundle_template is not None,
-                 self.virtual_batch_size),
+                 self.virtual_batch_size, self._chunk_bytes),
                 op=_count_merge,
             )
         except RpcError:
@@ -774,7 +795,8 @@ class Accumulator:
             # race-free while _model_version keeps moving on RPC threads.
             self._results.append((out[0], out[1], self._model_version))
 
-    def _start_grad_round(self, count: int, chunked: bool = False):
+    def _start_grad_round(self, count: int, chunked: bool = False,
+                          chunk_bytes: Optional[int] = None):
         """All peers enter deterministically once counts cross the virtual
         batch size (reference: startReduce, src/accumulator.cc:1005-1033).
 
@@ -782,14 +804,15 @@ class Accumulator:
         triggered inside count-round completions, which are totally ordered,
         so keys agree across peers even with several rounds in flight.
 
-        ``chunked`` (negotiated through the count round, identical on every
-        member): the payload becomes ``{"b": bundle-or-zeros, "n": [ng]}``
-        under the BUILTIN sum — the group layer then pipelines it through
-        the tree as a bounded number of concurrent chunks (size
-        ``max(_CHUNK_BYTES, total/_CHUNK_DEPTH)``, see rpc/group.py) with
-        in-place merges, where the None-tolerant custom merge ships one
-        monolithic message per hop. Non-contributors pay a zeros bundle;
-        contributors (the common steady-state case) pay nothing extra.
+        ``chunked`` and ``chunk_bytes`` (both negotiated through the count
+        round, identical on every member): the payload becomes
+        ``{"b": bundle-or-zeros, "n": [ng]}`` under the BUILTIN sum — the
+        group layer then pipelines it through the tree as a bounded number
+        of concurrent chunks (size ``max(chunk_bytes, total/_CHUNK_DEPTH)``,
+        see rpc/group.py) with in-place merges, where the None-tolerant
+        custom merge ships one monolithic message per hop. Non-contributors
+        pay a zeros bundle; contributors (the common steady-state case) pay
+        nothing extra.
         """
         epoch = self._epoch
         gseq = self._gseq
@@ -860,6 +883,7 @@ class Accumulator:
                     {"b": payload_bundle,
                      "n": np.array([ngrads], np.int64)},
                     op="sum",
+                    chunk_bytes=chunk_bytes,
                 )
             else:
                 fut = self.group.all_reduce(
@@ -884,6 +908,7 @@ class Accumulator:
                 "count_rounds": self._seq,
                 "gradient_rounds": self._gseq,
                 "chunked_gradient_rounds": self._chunked_rounds,
+                "negotiated_chunk_bytes": self._neg_chunk,
                 "gradient_rounds_inflight": self._grads_inflight,
                 "results_queued": len(self._results),
                 "parallel_gradients": self._parallel,

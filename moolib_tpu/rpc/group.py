@@ -61,13 +61,26 @@ REDUCE_OPS: Dict[str, Callable] = {
 # at _CHUNK_BYTES: depth beyond ~4 only multiplies per-message overhead
 # (measured: on a single-core loopback — zero cross-host concurrency to
 # exploit — chunking is pure overhead, so the floor keeps the message
-# count small; on multi-host DCN the depth-4 pipeline is the win).
+# count small; on multi-host DCN the depth-4 pipeline is the win; the
+# injected-latency A/B in tools/allreduce_latency_ab.py demonstrates the
+# overlap win without a second host).
+#
+# CLUSTER-WIDE CONSISTENCY: chunk geometry (sub-op keys name#cN + chunk
+# boundaries) is derived from the chunk size, so every member of a reduce
+# MUST use the same value or the round stalls until timeout. Callers with
+# a negotiation channel should pass an explicitly agreed ``chunk_bytes``
+# to ``all_reduce`` (the Accumulator carries it through its count round,
+# min-merged, so mixed env settings converge instead of livelocking);
+# bare ``all_reduce`` users fall back to this env default, which must
+# then be identical on every host — including across rolling upgrades
+# that change the default.
 _ELEMENTWISE = frozenset({_sum, _prod, _min, _max})
 _CHUNK_BYTES = int(__import__("os").environ.get(
     "MOOLIB_TPU_ALLREDUCE_CHUNK", 1 << 23
 ))
 _CHUNK_DEPTH = 4
-_CHUNK_THRESHOLD = 2 * _CHUNK_BYTES if _CHUNK_BYTES else (1 << 62)
+#: Public default for callers that negotiate chunk geometry themselves.
+CHUNK_BYTES_DEFAULT = _CHUNK_BYTES
 
 
 class AllReduce(Future):
@@ -296,20 +309,30 @@ class Group:
     # -- allreduce -----------------------------------------------------------
 
     def all_reduce(self, name: str, data: Any,
-                   op: Union[str, Callable] = "sum") -> AllReduce:
+                   op: Union[str, Callable] = "sum",
+                   chunk_bytes: Optional[int] = None) -> AllReduce:
         """Start an async tree allreduce; returns a Future
         (reference: AllReduceService::allReduce, src/group.h:687-787).
 
         Multi-MB payloads under elementwise builtin ops are chunked into
-        concurrent sub-ops for pipelined transfer (see _CHUNK_BYTES)."""
+        concurrent sub-ops for pipelined transfer. ``chunk_bytes``
+        overrides the env default (0 disables chunking entirely); chunk
+        geometry determines sub-op keys and boundaries, so it must be
+        IDENTICAL on every member — pass a negotiated value (as the
+        Accumulator does through its count round) when members may be
+        configured differently."""
         op_fn = _resolve_op(op)
-        if op_fn in _ELEMENTWISE:
+        floor = _CHUNK_BYTES if chunk_bytes is None else int(chunk_bytes)
+        threshold = 2 * floor if floor else (1 << 62)
+        if op_fn in _ELEMENTWISE and floor:
             leaves = nest.flatten(data)
             if (
                 all(isinstance(x, np.ndarray) for x in leaves)
-                and sum(x.nbytes for x in leaves) > _CHUNK_THRESHOLD
+                and sum(x.nbytes for x in leaves) > threshold
             ):
-                return self._all_reduce_chunked(name, data, leaves, op_fn)
+                return self._all_reduce_chunked(
+                    name, data, leaves, op_fn, floor
+                )
         return self._all_reduce_one(name, data, op_fn)
 
     def _all_reduce_one(self, name: str, data: Any,
@@ -337,17 +360,18 @@ class Group:
         return fut
 
     def _all_reduce_chunked(self, name: str, data: Any, leaves: List[np.ndarray],
-                            op_fn: Callable) -> AllReduce:
-        """Split an elementwise reduce into concurrent ~_CHUNK_BYTES sub-ops.
+                            op_fn: Callable, chunk_floor: int) -> AllReduce:
+        """Split an elementwise reduce into concurrent ~chunk_floor sub-ops.
 
-        Chunk boundaries depend only on the leaf shapes (identical on every
-        member), so all peers produce matching sub-op keys. Each sub-op's
-        payload is a flat list of array views; the parent future reassembles
-        the original pytree when the last sub-op lands."""
+        Chunk boundaries depend only on the leaf shapes and chunk_floor
+        (which callers must ensure is identical on every member — see
+        all_reduce), so all peers produce matching sub-op keys. Each
+        sub-op's payload is a flat list of array views; the parent future
+        reassembles the original pytree when the last sub-op lands."""
         # Bounded pipeline depth: chunk = max(floor, total/_CHUNK_DEPTH).
         total_bytes = sum(x.nbytes for x in leaves)
         chunk_bytes = max(
-            _CHUNK_BYTES, -(-total_bytes // _CHUNK_DEPTH)
+            chunk_floor, -(-total_bytes // _CHUNK_DEPTH)
         )
         pieces: List[tuple] = []  # (leaf_idx, flat view)
         for li, leaf in enumerate(leaves):
@@ -376,7 +400,7 @@ class Group:
         results: List[Any] = [None] * len(groups)
         remaining = [len(groups)]
         done_lock = threading.Lock()
-        reassembler = _completion_executor()
+        reassembler = _merge_executor()
 
         def reassemble():
             per_leaf: Dict[int, List[np.ndarray]] = {}
@@ -402,14 +426,21 @@ class Group:
                     remaining[0] -= 1
                     last = remaining[0] == 0
                 if last:
-                    # Sub-op futures complete on the RPC IO thread (inline
-                    # share handler); the multi-MB concatenate must not run
-                    # there, so reassembly gets its own thread.
+                    # The multi-MB concatenate runs on the merge pool; the
+                    # parent's completion (which runs user done-callbacks
+                    # inline) hops to the completion pool so a blocking
+                    # user callback can never occupy a merge thread.
                     def finish():
                         try:
-                            parent._set_result(reassemble())
+                            result = reassemble()
                         except Exception as e:  # defensive: shape mismatch
-                            parent._set_exception(e)
+                            _completion_executor().submit(
+                                parent._set_exception, e
+                            )
+                            return
+                        _completion_executor().submit(
+                            parent._set_result, result
+                        )
                     reassembler.submit(finish)
             return cb
 
@@ -439,10 +470,11 @@ class Group:
         if op.op_fn not in _ELEMENTWISE:
             # Custom ops (e.g. the Accumulator's gradient-bundle merge) can
             # be arbitrarily heavy and must not run on the inline RPC IO
-            # thread. The completion pool is multi-threaded; per-op merge
-            # ordering is guaranteed by op.lock in _merge_and_forward, NOT
-            # by pool width.
-            _completion_executor().submit(self._merge_and_forward, op, payload)
+            # thread — and must not share a pool with user done-callbacks
+            # that may block on collectives (see _merge_executor). Per-op
+            # merge ordering is guaranteed by op.lock in _merge_and_forward,
+            # NOT by pool width.
+            _merge_executor().submit(self._merge_and_forward, op, payload)
             return
         self._merge_and_forward(op, payload)
 
@@ -518,19 +550,22 @@ class Group:
 
 
 _completion_pool = None
+_merge_pool = None
 _completion_pool_lock = threading.Lock()
 
 
 def _completion_executor():
-    """Shared executor for allreduce future completions, custom-op merges,
-    and chunk reassembly.
+    """Executor for USER-FACING allreduce future completions.
 
     Deliberately NOT the Rpc function executor (user handlers may block on
     allreduce futures from those threads) and deliberately more than one
     thread: a done-callback that synchronously waits on ONE other collective
     still makes progress. Contract (same as the reference's scheduler
     callbacks): done-callbacks must not block indefinitely — a callback
-    chain deeper than the pool width can still starve itself."""
+    chain deeper than the pool width can still starve itself. Internal
+    reduce progress (custom-op merges, chunk reassembly) runs on the
+    SEPARATE _merge_executor so blocking user callbacks can never starve
+    the collectives they are waiting on."""
     global _completion_pool
     with _completion_pool_lock:
         if _completion_pool is None:
@@ -540,6 +575,26 @@ def _completion_executor():
                 max_workers=4, thread_name_prefix="allreduce-complete"
             )
         return _completion_pool
+
+
+def _merge_executor():
+    """Executor for INTERNAL reduce progress: custom-op merges and chunk
+    reassembly. Separate from the user-callback pool because a user
+    done-callback is allowed to block on another collective — if merges
+    queued behind such callbacks in one shared pool, four blocking
+    callbacks would deadlock the group layer (the merges their collectives
+    need could never run). Per-op merge ordering comes from op.lock, not
+    pool width, so two threads are about parallel reassembly, not
+    correctness."""
+    global _merge_pool
+    with _completion_pool_lock:
+        if _merge_pool is None:
+            import concurrent.futures
+
+            _merge_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="allreduce-merge"
+            )
+        return _merge_pool
 
 
 def _resolve_op(op) -> Callable:

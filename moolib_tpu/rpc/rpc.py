@@ -36,6 +36,7 @@ import asyncio
 import atexit
 import concurrent.futures
 import hashlib
+import heapq
 import itertools
 import math
 import os
@@ -196,10 +197,16 @@ class Queue:
         self._async_waiters: List[Tuple[Any, Any]] = []  # (loop, event)
 
     def _push(self, return_cb, args, kwargs):
+        # Locally-enqueued items have no caller deadline to honor — they
+        # keep forever even on an RPC-bound queue (whose _timeout is the
+        # RPC timeout; stamping _RAW entries with it would silently drop
+        # idle-queue items, unlike the standalone-queue contract).
+        expiry = (
+            float("inf") if return_cb is self._RAW
+            else time.monotonic() + self._timeout()
+        )
         with self._cond:
-            self._entries.append(
-                (time.monotonic() + self._timeout(), return_cb, args, kwargs)
-            )
+            self._entries.append((expiry, return_cb, args, kwargs))
             self._cond.notify_all()
             waiters, self._async_waiters = self._async_waiters, []
         for loop, event in waiters:
@@ -208,7 +215,9 @@ class Queue:
     def enqueue(self, item: Any):
         """Add a local item; a get/await yields it verbatim (reference:
         QueueWrapper::enqueue, src/moolib.cc:1941). Only for non-batched
-        queues — coalescing is defined over RPC call triples."""
+        queues — coalescing is defined over RPC call triples. Items never
+        expire (RPC entries on the same queue still honor the caller's
+        deadline)."""
         if self.batch_size is not None:
             raise RpcError(
                 "enqueue() is only supported on non-batched queues"
@@ -479,7 +488,7 @@ class _Peer:
 
 class _Outgoing:
     __slots__ = ("rid", "peer_name", "fname", "frames", "future", "deadline",
-                 "sent_at", "conn", "poked_at", "acked")
+                 "sent_at", "conn", "poked_at", "acked", "next_slot")
 
     def __init__(self, rid, peer_name, fname, frames, future, deadline):
         self.rid = rid
@@ -492,6 +501,9 @@ class _Outgoing:
         self.conn: Optional[_Conn] = None
         self.poked_at = 0.0
         self.acked = False
+        # Deadline-wheel slot this call is scheduled in (see
+        # _sched_out): stale heap entries are skipped when they disagree.
+        self.next_slot = -1
 
 
 def _boot_id() -> str:
@@ -543,6 +555,16 @@ class Rpc:
         self._listen_addrs: List[str] = []
         self._servers: List[Any] = []
         self._outgoing: Dict[int, _Outgoing] = {}
+        # Deadline wheel: in-flight calls scheduled by next-attention time
+        # in a min-heap of (slot, seq, out). The 100ms timeout tick pops
+        # only DUE entries instead of scanning every in-flight call — the
+        # reference shards request tracking into buckets for the same
+        # reason (reference: Incoming/Outgoing buckets, src/rpc.cc:
+        # 1106-1184). Rescheduling pushes a fresh entry and bumps
+        # out.next_slot; stale entries are lazily skipped on pop.
+        self._out_heap: list = []
+        self._sched_seq = itertools.count()
+        self._timeout_entries_processed = 0  # observability / stress tests
         self._rid_counter = itertools.count(1)
         self._recent_rids: "OrderedDict[Tuple[str, int], bool]" = OrderedDict()
         self._response_cache: "OrderedDict[Tuple[str, int], List[Any]]" = OrderedDict()
@@ -973,14 +995,29 @@ class Rpc:
                 frames = serial.serialize(rid, FID_ERROR, error_msg)
             self._cache_response(key, frames)
             def _send():
-                peer = self._peers.get(peer_name)
-                target = None
-                if peer and peer.conns:
-                    target = _best_conn(peer)
-                elif not conn.is_closing():
-                    target = conn
-                if target is not None and not self._write_now(target, frames):
-                    self._loop.create_task(self._write(target, frames))
+                # Up to two routing attempts: _write_now returning False
+                # with the conn closing means the write RAISED and dropped
+                # it — retrying the same dead target would only produce an
+                # unconsumed task exception; re-route via another live conn
+                # instead. False with the conn still open is flow control:
+                # the awaitable path on the same conn is correct. If no
+                # route remains, the reply stays in the response cache and
+                # the client's poke replays it (the reliability backstop).
+                for _ in range(2):
+                    peer = self._peers.get(peer_name)
+                    if peer and peer.conns:
+                        target = _best_conn(peer)
+                    elif not conn.is_closing():
+                        target = conn
+                    else:
+                        return
+                    if target is None or self._write_now(target, frames):
+                        return
+                    if not target.is_closing():
+                        self._loop.create_task(
+                            self._write_quiet(target, frames)
+                        )
+                        return
             try:
                 self._loop.call_soon_threadsafe(_send)
             except RuntimeError:
@@ -1150,9 +1187,14 @@ class Rpc:
                     out.conn = conn
                     out.sent_at = time.monotonic()
                     if self._write_now(conn, out.frames):
+                        self._sched_out(
+                            out, self._next_check(out, out.sent_at)
+                        )
                         return
                     out.conn = None
             self._loop.create_task(self._send_out(out))
+            # Unrouted (or routing async): first wheel check one tick out.
+            self._sched_out(out, time.monotonic() + self._TICK)
         self._loop.call_soon_threadsafe(submit)
         return fut
 
@@ -1172,6 +1214,15 @@ class Rpc:
 
     def sync(self, peer: str, func: str, *args, **kwargs):
         return self.async_(peer, func, *args, **kwargs).result()
+
+    async def _write_quiet(self, conn: _Conn, frames: List[Any]):
+        """Awaitable write that swallows connection failures — for replies
+        whose loss is covered by another mechanism (the poke/response-cache
+        replay), where a raised-but-unconsumed task exception is noise."""
+        try:
+            await self._write(conn, frames)
+        except Exception:
+            pass
 
     async def _send_out(self, out: _Outgoing):
         try:
@@ -1219,25 +1270,61 @@ class Rpc:
 
     # -- timeouts / keepalive ------------------------------------------------
 
+    _TICK = 0.1  # timeout-wheel resolution (matches the loop period)
+
+    def _sched_out(self, out: _Outgoing, when: float):
+        """(Re)schedule ``out`` on the deadline wheel — LOOP THREAD ONLY."""
+        slot = int(when / self._TICK)
+        out.next_slot = slot
+        heapq.heappush(self._out_heap, (slot, next(self._sched_seq), out))
+
+    def _next_check(self, out: _Outgoing, now: float) -> float:
+        """Earliest future instant this call needs attention: unrouted
+        calls retry every tick; un-acked ones at their next poke time;
+        acked ones only at the deadline."""
+        if out.conn is None:
+            return now + self._TICK
+        if out.acked:
+            return out.deadline
+        lat = out.conn.latency.value or 0.0
+        poke_after = min(max(4.0 * lat, self._poke_min), self._timeout / 2)
+        return min(out.deadline, max(out.sent_at, out.poked_at) + poke_after)
+
     async def _timeout_loop(self):
         """Expire calls, retry unrouted sends, keepalive idle connections
-        (reference: timeoutThreadEntry, src/rpc.cc:1667-1760)."""
+        (reference: timeoutThreadEntry, src/rpc.cc:1667-1760).
+
+        In-flight call bookkeeping is O(due entries), not O(in-flight):
+        the deadline wheel only surfaces calls whose next poke/expiry/
+        retry time has arrived (an acting plane with thousands of
+        concurrent calls costs this loop nothing between events)."""
         while not self._closed:
             try:
                 now = time.monotonic()
                 ka = self._keepalive_interval
-                for rid, out in list(self._outgoing.items()):
+                cur_slot = int(now / self._TICK)
+                heap = self._out_heap
+                while heap and heap[0][0] <= cur_slot:
+                    slot, _seq, out = heapq.heappop(heap)
+                    if out.next_slot != slot:
+                        continue  # superseded by a newer schedule
+                    rid = out.rid
+                    if self._outgoing.get(rid) is not out:
+                        continue  # answered (response path popped it)
                     if out.future.done():
                         self._outgoing.pop(rid, None)
                         continue
+                    self._timeout_entries_processed += 1
                     if now >= out.deadline:
                         self._outgoing.pop(rid, None)
                         out.future._set_exception(
                             RpcError(
-                                f"call to {out.peer_name}::{out.fname} timed out"
+                                f"call to {out.peer_name}::{out.fname} "
+                                "timed out"
                             )
                         )
-                    elif out.conn is None:
+                        continue
+                    if out.conn is None:
                         await self._send_out(out)
                     elif not out.acked:
                         # Unanswered and un-acked: poke the server after a
@@ -1254,7 +1341,7 @@ class Rpc:
                             conn = _best_conn(peer) if peer and peer.conns \
                                 else None
                             if conn is None:
-                                out.conn = None  # re-route next tick
+                                out.conn = None  # re-route on next check
                             else:
                                 try:
                                     await self._write(
@@ -1265,6 +1352,9 @@ class Rpc:
                                     )
                                 except Exception:
                                     pass
+                    self._sched_out(
+                        out, max(self._next_check(out, now), now + self._TICK)
+                    )
                 # re-dial dropped/failed explicit connections
                 for addr, entry in list(self._explicit.items()):
                     conn = entry["conn"]
@@ -1304,6 +1394,11 @@ class Rpc:
     def debug_info(self) -> dict:
         """Per-peer transport/latency info (reference: src/rpc.cc:1598-1623)."""
         info = {"name": self._name, "listen": list(self._listen_addrs),
+                "in_flight": len(self._outgoing),
+                # Wheel-entry processing count: stress tests assert this
+                # stays O(events), not O(in-flight x ticks).
+                "timeout_entries_processed":
+                    self._timeout_entries_processed,
                 "peers": {}}
         for peer in self._peers.values():
             info["peers"][peer.name] = {

@@ -151,6 +151,39 @@ def test_accumulation_across_rounds(cluster):
     np.testing.assert_allclose(mean["g"], np.full(2, 6 / 8))
 
 
+def test_chunk_geometry_negotiated_across_heterogeneous_settings(cluster):
+    """ADVICE r4 (medium): peers configured with DIFFERENT chunk sizes
+    (mixed MOOLIB_TPU_ALLREDUCE_CHUNK env, or a rolling upgrade changing
+    the default) must converge on the min through the count round instead
+    of producing divergent sub-op keys that stall every large reduce."""
+    accs = [
+        _spawn_acc(cluster, "pA", vbs=2, chunk_bytes=1 << 16),
+        _spawn_acc(cluster, "pB", vbs=2, chunk_bytes=1 << 20),
+    ]
+    _pump(accs, lambda: all(
+        a.connected() and a.wants_gradients() for a in accs
+    ))
+    big = {"w": np.ones(100_000, np.float64)}  # 800KB >> 2 * 64KB
+    # Round 1 teaches the wire template; round 2 goes chunked with the
+    # negotiated geometry.
+    for rnd in range(2):
+        for i, a in enumerate(accs):
+            a.reduce_gradients(
+                {"w": big["w"] * (i + 1)}, batch_size=1
+            )
+        _pump(accs, lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            mean, count = a.result_gradients()
+            assert count == 2
+            np.testing.assert_allclose(mean["w"][:3], np.full(3, 1.5))
+            a.zero_gradients()
+        _pump(accs, lambda: all(a.wants_gradients() for a in accs))
+    for a in accs:
+        stats = a.get_gradient_stats()
+        assert stats["negotiated_chunk_bytes"] == 1 << 16, stats
+        assert stats["chunked_gradient_rounds"] >= 1, stats
+
+
 def test_skip_gradients_keeps_cluster_moving(cluster):
     accs = [_spawn_acc(cluster, f"p{i}", vbs=4) for i in range(3)]
     _pump(accs, lambda: all(a.connected() and a.wants_gradients() for a in accs))

@@ -57,6 +57,42 @@ def test_envpool_busy_buffer_raises(rng):
         pool.step(0, np.zeros(2, np.int64)).result(timeout=10)
 
 
+def test_late_callback_sees_own_step_not_newer_buffer_state(rng):
+    """ADVICE r4: a callback registered AFTER its step was collected — and
+    after a newer step was dispatched on the same buffer — must observe the
+    step it belongs to (the cached outcome), not a re-read of shared buffer
+    state the newer step may have overwritten."""
+    import threading
+
+    B = 2
+    with EnvPool(FakeEnv, num_processes=1, batch_size=B) as pool:
+        f_old = pool.step(0, np.zeros(B, np.int64))
+        r_old = f_old.result(timeout=10)
+        old_step = np.array(r_old["episode_step"], copy=True)
+        # Newer step in flight on the SAME buffer before the late
+        # registration.
+        f_new = pool.step(0, np.ones(B, np.int64))
+        fired = threading.Event()
+        seen = {}
+
+        def cb(fut):
+            seen["out"] = fut.result()
+            fired.set()
+
+        f_old.add_done_callback(cb)
+        # Fires promptly with this future's CACHED collection — it must
+        # not be re-registered against the newer in-flight step, and its
+        # result() must not re-collect shared buffer state. (The numpy
+        # views inside keep their documented lifetime: valid until the
+        # buffer's next step; identity is the attribution guarantee.)
+        assert fired.wait(5), "late callback never fired"
+        assert seen["out"] is r_old
+        r_new = f_new.result(timeout=10)
+        assert (r_new["episode_step"] == old_step + 1).all()
+        # The old future keeps answering with its own cached collection.
+        assert f_old.result() is r_old
+
+
 def test_envpool_dict_obs_and_episode_stats(rng):
     B = 4
     with EnvPool(DictObsEnv, num_processes=2, batch_size=B) as pool:

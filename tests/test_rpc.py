@@ -183,6 +183,19 @@ def test_queue(pair):
     assert fut.result(timeout=10) == 6
 
 
+def test_enqueue_on_rpc_queue_never_expires(pair):
+    """ADVICE r4: locally-enqueued items on an RPC-bound queue must keep
+    forever (the standalone-queue contract) — only RPC entries honor the
+    caller's deadline. A short RPC timeout must not silently drop them."""
+    host, client = pair
+    host.set_timeout(0.2)
+    q = host.define_queue("mixedq")
+    q.enqueue("precious")
+    time.sleep(0.5)  # well past the RPC timeout stamp the bug applied
+    got = q.get(timeout=5)
+    assert got == "precious"
+
+
 def test_batched_define(pair, rng):
     """define(batch_size=) stacks concurrent calls (reference: test_batch.py)."""
     host, client = pair
