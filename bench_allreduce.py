@@ -140,6 +140,7 @@ def bench_ici_psum(sizes=(2**20, 2**23, 2**25)):
     from jax.sharding import PartitionSpec as P
 
     from moolib_tpu.parallel.mesh import make_mesh
+    from moolib_tpu.utils.jaxenv import shard_map
 
     n = len(jax.devices())
     if watchdog is not None:
@@ -167,7 +168,7 @@ def bench_ici_psum(sizes=(2**20, 2**23, 2**25)):
             def inner(x):
                 return jax.lax.psum(x, "dp")
 
-            return jax.shard_map(
+            return shard_map(
                 inner, mesh=mesh, in_specs=P("dp", None),
                 out_specs=P("dp", None),
             )(x)

@@ -1,0 +1,59 @@
+"""moolint: project-native static analysis for async-RPC safety and JAX
+trace hygiene.
+
+The reference moolib's correctness invariants (no blocking in the IO loop,
+cancellation never swallowed, every future consumed) were enforced by C++
+RAII and review; this package makes the same invariant families — plus the
+TPU-specific trace-hygiene ones (no host syncs or Python RNG inside jitted
+hot paths) — self-enforcing via an AST lint suite that runs as a tier-1
+test against a checked-in baseline (``baseline.json``).
+
+Entry points:
+
+- ``python tools/moolint.py moolib_tpu/`` — CLI (``--check``, ``--json``,
+  ``--baseline-update``, ``--list-rules``).
+- ``tests/test_lint.py`` — tier-1 enforcement: new findings fail CI.
+- :mod:`moolib_tpu.analysis.recompile_guard` — runtime companion pinning
+  jit compile counts in tests.
+
+This package deliberately imports neither JAX nor the RPC stack: linting a
+tree must stay runnable from a control-plane-only process.
+"""
+
+from .engine import (
+    Finding,
+    LintError,
+    Rule,
+    all_rules,
+    diff_against_baseline,
+    findings_to_baseline,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    save_baseline,
+)
+from .recompile_guard import (
+    RecompileBudgetExceeded,
+    RecompileGuard,
+    compile_count,
+    guarded_jit,
+    recompile_budget,
+)
+
+__all__ = [
+    "Finding",
+    "LintError",
+    "Rule",
+    "all_rules",
+    "diff_against_baseline",
+    "findings_to_baseline",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "save_baseline",
+    "RecompileBudgetExceeded",
+    "RecompileGuard",
+    "compile_count",
+    "guarded_jit",
+    "recompile_budget",
+]

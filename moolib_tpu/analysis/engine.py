@@ -1,0 +1,334 @@
+"""moolint engine: AST walk, findings, suppressions, baseline.
+
+Design (mirrors how large projects keep a lint suite adoptable):
+
+- A :class:`Rule` inspects one parsed module (:class:`ModuleContext`) and
+  yields :class:`Finding`\\ s. Rules are pure functions of the AST + source;
+  they never import the code under analysis.
+- Per-line suppression: ``# moolint: disable=<rule>[,<rule>...]`` on the
+  flagged line (or ``disable=all``). File-wide:
+  ``# moolint: disable-file=<rule>[,...]`` anywhere in the file.
+- Baseline: pre-existing findings are grandfathered in a checked-in JSON
+  file so the suite can land on a non-clean tree and still fail NEW
+  violations. Findings are identified by ``(path, rule, stripped source
+  line)`` — not line numbers — so unrelated edits that shift code do not
+  invalidate the baseline; duplicates are tracked by count.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+import tokenize
+from collections import Counter
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "LintError",
+    "ModuleContext",
+    "Rule",
+    "all_rules",
+    "diff_against_baseline",
+    "findings_to_baseline",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "save_baseline",
+]
+
+BASELINE_VERSION = 1
+
+_SUPPRESS_RE = re.compile(r"#\s*moolint:\s*disable=([\w\-,]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*moolint:\s*disable-file=([\w\-,]+)")
+
+
+class LintError(RuntimeError):
+    """Unrecoverable engine error (unreadable file, bad baseline)."""
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str  # posix path, repo-relative when under the lint root
+    line: int  # 1-based
+    col: int   # 0-based
+    rule: str
+    message: str
+    snippet: str = ""  # stripped source line — the baseline identity
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers intentionally excluded."""
+        return (self.path, self.rule, self.snippet)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``description`` and implement
+    :meth:`check`. Registration happens via the rule modules' ``RULES``
+    lists (see :func:`all_rules`)."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: "ModuleContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    # Convenience for subclasses.
+    def finding(self, ctx: "ModuleContext", node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        snippet = ctx.line(line).strip()
+        return Finding(path=ctx.relpath, line=line, col=col,
+                       rule=self.name, message=message, snippet=snippet)
+
+
+class ModuleContext:
+    """One parsed module plus the derived facts rules share."""
+
+    def __init__(self, source: str, relpath: str):
+        self.source = source
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        try:
+            self.tree = ast.parse(source, filename=relpath)
+        except SyntaxError as e:
+            raise LintError(f"{relpath}: syntax error: {e}") from None
+        self._suppressed_lines: Dict[int, set] = {}
+        self._suppressed_file: set = set()
+        self._scan_suppressions()
+
+    # -- suppressions --------------------------------------------------------
+
+    def _scan_suppressions(self):
+        """Collect suppression comments via tokenize (comments are invisible
+        to ast). Malformed/partial source falls back to a line regex scan."""
+        try:
+            tokens = list(tokenize.generate_tokens(
+                iter(self.source.splitlines(keepends=True)).__next__
+            ))
+        except (tokenize.TokenError, IndentationError):
+            tokens = None
+        comments: List[Tuple[int, str]] = []
+        if tokens is not None:
+            comments = [
+                (tok.start[0], tok.string)
+                for tok in tokens if tok.type == tokenize.COMMENT
+            ]
+        else:
+            for i, text in enumerate(self.lines, start=1):
+                if "#" in text:
+                    comments.append((i, text[text.index("#"):]))
+        for lineno, text in comments:
+            m = _SUPPRESS_FILE_RE.search(text)
+            if m:
+                self._suppressed_file.update(m.group(1).split(","))
+                continue
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                self._suppressed_lines.setdefault(lineno, set()).update(
+                    m.group(1).split(",")
+                )
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if {"all", rule} & self._suppressed_file:
+            return True
+        rules = self._suppressed_lines.get(line)
+        return bool(rules) and bool({"all", rule} & rules)
+
+    # -- helpers -------------------------------------------------------------
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def imports_any(self, *modules: str) -> bool:
+        """True if the module imports any of ``modules`` (top-level name
+        match, e.g. 'concurrent' matches 'concurrent.futures')."""
+        tops = set(modules)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] in tops:
+                        return True
+            elif isinstance(node, ast.ImportFrom):
+                if node.module and node.module.split(".")[0] in tops:
+                    return True
+        return False
+
+    def has_async_def(self) -> bool:
+        return any(
+            isinstance(n, ast.AsyncFunctionDef) for n in ast.walk(self.tree)
+        )
+
+
+# -- running -----------------------------------------------------------------
+
+
+def all_rules() -> List[Rule]:
+    """The full registered rule set (async-safety + JAX trace hygiene)."""
+    from . import rules_async, rules_jax
+
+    return [cls() for cls in rules_async.RULES + rules_jax.RULES]
+
+
+def _select_rules(rules: Optional[Sequence[Rule]],
+                  only: Optional[Sequence[str]]) -> List[Rule]:
+    selected = list(rules) if rules is not None else all_rules()
+    if only:
+        wanted = set(only)
+        unknown = wanted - {r.name for r in selected}
+        if unknown:
+            raise LintError(f"unknown rule(s): {sorted(unknown)}")
+        selected = [r for r in selected if r.name in wanted]
+    return selected
+
+
+def lint_source(source: str, relpath: str = "<string>",
+                rules: Optional[Sequence[Rule]] = None,
+                only: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one source string; the unit-test surface."""
+    ctx = ModuleContext(source, relpath)
+    out: List[Finding] = []
+    for rule in _select_rules(rules, only):
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f.rule, f.line):
+                out.append(f)
+    return sorted(out)
+
+
+def iter_py_files(paths: Sequence[Path]) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                # Filter on the path BELOW the scanned root only: a repo
+                # checked out under a dot-directory ancestor must still
+                # lint (filtering sub.parts would skip everything,
+                # silently passing vacuously).
+                rel_parts = sub.relative_to(p).parts
+                if any(part.startswith(".") for part in rel_parts):
+                    continue
+                if "__pycache__" in rel_parts:
+                    continue
+                yield sub
+        elif p.suffix == ".py":
+            yield p
+        elif not p.exists():
+            raise LintError(f"no such path: {p}")
+
+
+def list_lint_files(paths: Sequence[Path],
+                    root: Optional[Path] = None) -> List[str]:
+    """Relative (posix) paths of the files a :func:`lint_paths` call with
+    the same arguments would visit — used to scope baseline comparisons to
+    what was actually linted."""
+    root = Path(root) if root is not None else Path.cwd()
+    out = []
+    for path in iter_py_files(paths):
+        try:
+            out.append(path.resolve().relative_to(root.resolve()).as_posix())
+        except ValueError:
+            out.append(path.resolve().as_posix())
+    return out
+
+
+def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
+               rules: Optional[Sequence[Rule]] = None,
+               only: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint files/trees. ``root`` anchors the relative paths findings carry
+    (default: the current working directory); files outside ``root`` fall
+    back to absolute paths so they can never collide with baselined ones."""
+    root = Path(root) if root is not None else Path.cwd()
+    selected = _select_rules(rules, only)
+    out: List[Finding] = []
+    for path in iter_py_files(paths):
+        try:
+            source = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            raise LintError(f"cannot read {path}: {e}") from None
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.resolve().as_posix()
+        try:
+            ctx = ModuleContext(source, rel)
+        except LintError:
+            # A file that does not parse is someone else's failure (the
+            # import suite); the linter skips it rather than masking every
+            # other finding behind one broken scratch file.
+            continue
+        for rule in selected:
+            for f in rule.check(ctx):
+                if not ctx.suppressed(f.rule, f.line):
+                    out.append(f)
+    return sorted(out)
+
+
+# -- baseline ----------------------------------------------------------------
+
+
+def findings_to_baseline(findings: Iterable[Finding]) -> dict:
+    counts = Counter(f.key() for f in findings)
+    return {
+        "version": BASELINE_VERSION,
+        "findings": [
+            {"path": path, "rule": rule, "snippet": snippet, "count": n}
+            for (path, rule, snippet), n in sorted(counts.items())
+        ],
+    }
+
+
+def save_baseline(path: Path, findings: Iterable[Finding]):
+    data = findings_to_baseline(findings)
+    Path(path).write_text(json.dumps(data, indent=1) + "\n", encoding="utf-8")
+
+
+def load_baseline(path: Path) -> dict:
+    try:
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        raise LintError(f"cannot load baseline {path}: {e}") from None
+    if not isinstance(data, dict) or data.get("version") != BASELINE_VERSION:
+        raise LintError(f"baseline {path}: unsupported format")
+    return data
+
+
+def diff_against_baseline(
+    findings: Sequence[Finding], baseline: Optional[dict]
+) -> Tuple[List[Finding], List[dict]]:
+    """-> (new findings, fixed baseline entries).
+
+    A finding is NEW when its (path, rule, snippet) count exceeds the
+    baselined count; a baseline entry is FIXED when the tree now has fewer
+    occurrences than baselined (the baseline should be shrunk with
+    ``--baseline-update``)."""
+    allowed: Counter = Counter()
+    if baseline is not None:
+        for e in baseline.get("findings", []):
+            allowed[(e["path"], e["rule"], e["snippet"])] += int(
+                e.get("count", 1)
+            )
+    seen: Counter = Counter()
+    new: List[Finding] = []
+    for f in sorted(findings):
+        seen[f.key()] += 1
+        if seen[f.key()] > allowed.get(f.key(), 0):
+            new.append(f)
+    fixed = [
+        {"path": k[0], "rule": k[1], "snippet": k[2],
+         "count": n - seen.get(k, 0)}
+        for k, n in sorted(allowed.items()) if seen.get(k, 0) < n
+    ]
+    return new, fixed

@@ -1,0 +1,153 @@
+"""Runtime companion to the trace-hygiene rules: pin jit compile counts.
+
+The static rules catch host syncs and un-static scalars they can see;
+this module catches what they cannot — any recompilation storm, whatever
+its cause — by counting actual jit cache misses at test time:
+
+    apply = jax.jit(net.apply)
+    with recompile_budget(apply, max_compiles=1):
+        for batch in batches:          # same shapes/dtypes
+            apply(params, *batch)      # must compile exactly once
+
+Two counting mechanisms, used in preference order:
+
+- the jit callable's ``_cache_size()`` (one entry per distinct
+  (shapes, dtypes, statics) signature — a cache miss IS a compile);
+- for callables that don't expose it (older/newer JAX), wrap the python
+  function with :func:`guarded_jit`, which counts retraces directly
+  (every compile traces the python body exactly once).
+
+JAX is imported lazily: importing :mod:`moolib_tpu.analysis` from a
+control-plane-only process must stay free of XLA initialization.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "RecompileBudgetExceeded",
+    "RecompileGuard",
+    "compile_count",
+    "guarded_jit",
+    "recompile_budget",
+]
+
+
+class RecompileBudgetExceeded(RuntimeError):
+    """A guarded block compiled more often than its budget allows."""
+
+
+def compile_count(fn: Any) -> Optional[int]:
+    """Best-effort number of compiled variants held by ``fn``.
+
+    Understands :class:`GuardedJit` wrappers and any jit callable exposing
+    ``_cache_size()``. Returns None when the count is unreadable."""
+    if isinstance(fn, GuardedJit):
+        return fn.compiles
+    get = getattr(fn, "_cache_size", None)
+    if callable(get):
+        try:
+            return int(get())
+        except Exception:
+            return None
+    return None
+
+
+class GuardedJit:
+    """``jax.jit`` wrapper that counts its own cache misses.
+
+    Counts python retraces (one per compile) so it works on any JAX
+    version; when the underlying jit exposes ``_cache_size()`` that is
+    used instead (it also survives ``clear_cache()`` correctly)."""
+
+    def __init__(self, fun: Callable, **jit_kwargs):
+        import jax
+
+        self._traces = 0
+
+        @functools.wraps(fun)
+        def counted(*args, **kwargs):
+            self._traces += 1
+            return fun(*args, **kwargs)
+
+        self._jfn = jax.jit(counted, **jit_kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return self._jfn(*args, **kwargs)
+
+    @property
+    def compiles(self) -> int:
+        get = getattr(self._jfn, "_cache_size", None)
+        if callable(get):
+            try:
+                return int(get())
+            except Exception:
+                pass
+        return self._traces
+
+    def clear_cache(self):
+        clear = getattr(self._jfn, "clear_cache", None)
+        if callable(clear):
+            clear()
+
+    def __getattr__(self, name):
+        return getattr(self._jfn, name)
+
+
+def guarded_jit(fun: Optional[Callable] = None, **jit_kwargs):
+    """``jax.jit`` drop-in whose result exposes ``.compiles``. Usable as
+    ``guarded_jit(f)``, ``@guarded_jit`` or ``@guarded_jit(static_argnames=...)``."""
+    if fun is None:
+        return lambda f: GuardedJit(f, **jit_kwargs)
+    return GuardedJit(fun, **jit_kwargs)
+
+
+class RecompileGuard:
+    """Context manager asserting a jitted callable compiles at most
+    ``max_compiles`` times inside the ``with`` block.
+
+    The check runs on clean exit only (an exception inside the block wins);
+    ``.compiles`` is readable at any point for finer assertions."""
+
+    def __init__(self, fn: Any, max_compiles: int = 1,
+                 label: Optional[str] = None):
+        if compile_count(fn) is None:
+            raise TypeError(
+                "cannot read a compile count from "
+                f"{getattr(fn, '__name__', fn)!r}; pass a jax.jit result "
+                "or wrap the function with guarded_jit()"
+            )
+        self.fn = fn
+        self.max_compiles = int(max_compiles)
+        self.label = label or getattr(fn, "__name__", repr(fn))
+        self._start: Optional[int] = None
+
+    @property
+    def compiles(self) -> int:
+        if self._start is None:
+            raise RuntimeError("RecompileGuard not entered")
+        now = compile_count(self.fn)
+        return 0 if now is None else now - self._start
+
+    def __enter__(self) -> "RecompileGuard":
+        self._start = compile_count(self.fn)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None and self.compiles > self.max_compiles:
+            raise RecompileBudgetExceeded(
+                f"{self.label}: compiled {self.compiles} time(s) in a "
+                f"block budgeted for {self.max_compiles} — a hot path is "
+                "retracing (changing shapes/dtypes or un-static Python "
+                "scalars)"
+            )
+        return False
+
+
+def recompile_budget(fn: Any, max_compiles: int = 1,
+                     label: Optional[str] = None) -> RecompileGuard:
+    """``with recompile_budget(jitted_fn, 1): ...`` — see
+    :class:`RecompileGuard`."""
+    return RecompileGuard(fn, max_compiles=max_compiles, label=label)

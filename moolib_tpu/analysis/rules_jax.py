@@ -1,0 +1,286 @@
+"""JAX trace-hygiene rules.
+
+Podracer-style TPU training loops live or die by trace hygiene: a stray
+``float()``/``np.asarray()`` host sync inside a jitted hot path serializes
+the device pipeline, and an un-static Python-scalar argument turns into a
+silent recompilation storm (one XLA compile per distinct value). These
+rules find both classes statically; the runtime companion
+(:mod:`moolib_tpu.analysis.recompile_guard`) pins actual compile counts in
+tests.
+
+"Traced" functions are found lexically: functions decorated with
+``jit``/``pmap`` (bare, ``jax.``-qualified, called, or via
+``functools.partial(jax.jit, ...)``), plus local functions passed by name
+to a ``jax.jit(...)``/``pmap(...)`` call, plus everything nested inside
+either. The analysis is intra-module and name-based — it will not follow a
+function object across modules (the compile-count tests cover that hole
+dynamically).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding, ModuleContext, Rule
+from .rules_async import _terminal_name
+
+__all__ = ["RULES"]
+
+_JIT_NAMES = {"jit", "pmap"}
+
+
+def _numpy_aliases(ctx: ModuleContext) -> Set[str]:
+    """Names the module binds to the numpy module (np, onp, numpy...)."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] == "numpy":
+                    out.add(alias.asname or alias.name.split(".")[0])
+    return out
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """Does ``node`` evaluate to a jit/pmap transform? Covers ``jit``,
+    ``jax.jit``, and ``functools.partial(jax.jit, ...)``."""
+    name = _terminal_name(node)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call) and _terminal_name(node.func) == "partial":
+        return bool(node.args) and _is_jit_expr(node.args[0])
+    return False
+
+
+def _jit_call_of(node: ast.expr) -> Optional[ast.Call]:
+    """The ``jax.jit(...)`` Call carrying static_argnames, if ``node`` is
+    one (directly or through partial)."""
+    if isinstance(node, ast.Call):
+        if _terminal_name(node.func) in _JIT_NAMES:
+            return node
+        if _terminal_name(node.func) == "partial" and node.args \
+                and _is_jit_expr(node.args[0]):
+            return node
+    return None
+
+
+def _decorator_jit_call(fn: ast.AST) -> Optional[Tuple[bool, Optional[ast.Call]]]:
+    """(is_jitted, jit Call node or None for a bare ``@jax.jit``)."""
+    for dec in getattr(fn, "decorator_list", []):
+        if _terminal_name(dec) in _JIT_NAMES:
+            return True, None
+        call = _jit_call_of(dec)
+        if call is not None:
+            return True, call
+        if isinstance(dec, ast.Call) and _is_jit_expr(dec):
+            return True, dec
+    return None
+
+
+def traced_functions(ctx: ModuleContext) -> Dict[ast.AST, Optional[ast.Call]]:
+    """FunctionDef/AsyncFunctionDef nodes whose bodies are traced under
+    jit/pmap, mapped to the jit Call node when one is visible (for
+    static_argnames inspection). Includes functions passed BY NAME to a
+    jit call anywhere in the module."""
+    out: Dict[ast.AST, Optional[ast.Call]] = {}
+    name_marked: Dict[str, ast.Call] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _terminal_name(node.func) in _JIT_NAMES:
+            if node.args and isinstance(node.args[0], ast.Name):
+                name_marked[node.args[0].id] = node
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        dec = _decorator_jit_call(node)
+        if dec is not None:
+            out[node] = dec[1]
+        elif node.name in name_marked:
+            out[node] = name_marked[node.name]
+    return out
+
+
+def _traced_subtree(fns: Iterable[ast.AST]) -> Iterable[ast.AST]:
+    """Every node lexically inside any traced function (nested defs and
+    lambdas INCLUDED: they execute during the same trace)."""
+    seen = set()
+    for fn in fns:
+        for node in ast.walk(fn):
+            if id(node) not in seen:
+                seen.add(id(node))
+                yield node
+
+
+_HOST_SYNC_METHODS = {
+    "item": "`.item()` forces a device->host sync inside a traced function",
+    "block_until_ready":
+        "`.block_until_ready()` inside a traced function defeats async "
+        "dispatch",
+    "tolist": "`.tolist()` forces a device->host sync inside a traced "
+              "function",
+}
+_NUMPY_MATERIALIZERS = {"asarray", "array", "copy"}
+
+
+class HostSyncInJit(Rule):
+    name = "host-sync-in-jit"
+    description = (
+        "host-synchronizing operation (float()/.item()/.tolist()/"
+        "np.asarray()/np.array()/.block_until_ready()/jax.device_get()) "
+        "reachable inside a jit/pmap-traced function: under tracing these "
+        "either fail on abstract values or silently pin the hot path to "
+        "the host."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        traced = traced_functions(ctx)
+        if not traced:
+            return
+        np_aliases = _numpy_aliases(ctx)
+        for node in _traced_subtree(traced):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "float" and node.args \
+                    and not isinstance(node.args[0], ast.Constant):
+                yield self.finding(
+                    ctx, node,
+                    "float() on a traced value forces a host sync (or "
+                    "fails under jit); use jnp ops and keep it on device",
+                )
+            elif isinstance(f, ast.Attribute) and f.attr in _HOST_SYNC_METHODS:
+                yield self.finding(ctx, node, _HOST_SYNC_METHODS[f.attr])
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr in _NUMPY_MATERIALIZERS
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in np_aliases):
+                yield self.finding(
+                    ctx, node,
+                    f"{f.value.id}.{f.attr}() materializes a traced value "
+                    "on the host; use jnp equivalents inside jitted code",
+                )
+            elif (isinstance(f, ast.Attribute) and f.attr == "device_get"):
+                yield self.finding(
+                    ctx, node,
+                    "jax.device_get() inside a traced function is a host "
+                    "sync; return the value instead",
+                )
+
+
+class PythonRandomInJit(Rule):
+    name = "python-random-in-jit"
+    description = (
+        "Python `random` / `np.random` inside a jit/pmap-traced function "
+        "executes once at trace time and bakes a constant into the "
+        "compiled program — every call replays the same 'random' numbers. "
+        "Thread a jax.random key instead."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        traced = traced_functions(ctx)
+        if not traced:
+            return
+        np_aliases = _numpy_aliases(ctx)
+        for node in _traced_subtree(traced):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            base = f.value
+            # random.<fn>(...)
+            if isinstance(base, ast.Name) and base.id == "random":
+                yield self.finding(
+                    ctx, node,
+                    f"random.{f.attr}() executes at trace time, not per "
+                    "call; use jax.random with an explicit key",
+                )
+            # np.random.<fn>(...) / np.random.default_rng(...).<fn>
+            elif (isinstance(base, ast.Attribute) and base.attr == "random"
+                  and isinstance(base.value, ast.Name)
+                  and base.value.id in np_aliases):
+                yield self.finding(
+                    ctx, node,
+                    f"{base.value.id}.random.{f.attr}() executes at trace "
+                    "time, not per call; use jax.random with an explicit "
+                    "key",
+                )
+
+
+def _static_argnames(call: Optional[ast.Call]) -> Optional[Set[str]]:
+    """Names declared static in a jit Call; None means 'has static args we
+    cannot enumerate' (be permissive), empty set means 'none declared'."""
+    if call is None:
+        return set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            names.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) for e in v.elts
+        ):
+            for e in v.elts:
+                if isinstance(e.value, str):
+                    names.add(e.value)
+                else:
+                    return None  # positional nums: cannot map to names
+        else:
+            return None  # computed expression: assume it covers everything
+    return names
+
+
+_SCALAR_ANNOTATIONS = {"int", "bool", "str"}
+
+
+class JitMissingStatic(Rule):
+    name = "jit-missing-static"
+    description = (
+        "jit-decorated function takes a Python scalar parameter "
+        "(int/bool/str annotation or default) that is not listed in "
+        "static_argnames: every distinct value triggers a silent retrace "
+        "and XLA recompile."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for fn, call in traced_functions(ctx).items():
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            statics = _static_argnames(call)
+            if statics is None:
+                continue  # un-enumerable static spec: trust it
+            args = fn.args
+            all_args = list(args.posonlyargs) + list(args.args) \
+                + list(args.kwonlyargs)
+            defaults: Dict[str, ast.expr] = {}
+            pos = list(args.posonlyargs) + list(args.args)
+            for a, d in zip(pos[len(pos) - len(args.defaults):],
+                            args.defaults):
+                defaults[a.arg] = d
+            for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                if d is not None:
+                    defaults[a.arg] = d
+            for a in all_args:
+                if a.arg in ("self", "cls") or a.arg in statics:
+                    continue
+                scalar = False
+                ann = _terminal_name(a.annotation) if a.annotation else None
+                if ann in _SCALAR_ANNOTATIONS:
+                    scalar = True
+                d = defaults.get(a.arg)
+                if isinstance(d, ast.Constant) and isinstance(
+                    d.value, (bool, int, str)
+                ) and not isinstance(d.value, float):
+                    scalar = True
+                if scalar:
+                    yield self.finding(
+                        ctx, a,
+                        f"param {a.arg!r} of jitted {fn.name!r} is a "
+                        "Python scalar not in static_argnames: each new "
+                        "value recompiles; mark it static or pass an "
+                        "array",
+                    )
+
+
+RULES = [HostSyncInJit, PythonRandomInJit, JitMissingStatic]

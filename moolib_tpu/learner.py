@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .ops import vtrace
 from .parallel.mesh import batch_specs, dp_average_grads
+from .utils.jaxenv import shard_map
 
 __all__ = [
     "ImpalaConfig",
@@ -230,7 +231,7 @@ def make_impala_train_step(
             )
             return sgd(state, grads, metrics)
 
-        return jax.shard_map(
+        return shard_map(
             inner,
             mesh=mesh,
             in_specs=(replicated, batch_specs(batch, batch_axes, axis_name)),
@@ -303,7 +304,7 @@ def make_grad_step(
             )
             return finish(grads, metrics)
 
-        return jax.shard_map(
+        return shard_map(
             inner,
             mesh=mesh,
             in_specs=(replicated, batch_specs(batch, batch_axes, axis_name)),

@@ -288,6 +288,12 @@ try:  # pallas is TPU/interpret-only; import lazily-ish at module load
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # jax 0.4.x spells it TPUCompilerParams; same kwargs. Keep the alias
+    # module-local — mutating the shared pltpu module would leak to other
+    # libraries' feature detection.
+    _CompilerParams = getattr(
+        pltpu, "CompilerParams", None
+    ) or pltpu.TPUCompilerParams
     _HAVE_PALLAS = True
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
@@ -345,7 +351,7 @@ def _flash_forward(q, k, v, seg_q, seg_k, causal, block_q, block_k,
             pltpu.VMEM((block_q,), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -490,7 +496,7 @@ def _flash_backward(q, k, v, seg_q, seg_k, out, lse, g, causal, block_q,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -522,7 +528,7 @@ def _flash_backward(q, k, v, seg_q, seg_k, out, lse, g, causal, block_q,
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

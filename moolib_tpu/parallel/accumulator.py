@@ -51,6 +51,8 @@ back is the proper per-sample mean over the virtual batch.
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import threading
 import time
 from collections import deque
@@ -480,6 +482,14 @@ class Accumulator:
         def done(fut):
             try:
                 version, leader = fut.result(timeout=0)
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                # Election cancelled mid-flight (epoch teardown): restore
+                # the retry gate, then PROPAGATE — cancellation swallowed
+                # here would wedge _electing until the next epoch.
+                with self._lock:
+                    self._electing = False
+                raise
             except Exception as e:
                 with self._lock:
                     self._electing = False  # retried next update()
@@ -673,10 +683,27 @@ class Accumulator:
             self._pending_ngrads += snap_ng
 
         def done(fut):
-            nonlocal snap_parts
+            nonlocal snap_parts, snap_bs, snap_ng
             try:
                 (total_bs, total_ng, all_templ, eff_vbs,
                  neg_chunk) = fut.result(timeout=0)
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                # The in-flight reduction was CANCELLED (elastic membership
+                # change tearing down the round): restore the snapshot and
+                # re-arm the round/poll gates exactly like a failure, then
+                # PROPAGATE. Before moolint this fell into the broad
+                # handler's compaction path or — worse — escaped it,
+                # skipping the bookkeeping and wedging _round_inflight
+                # forever. Compaction is skipped: raw staged parts restore
+                # fine and the epoch reset usually re-counts them anyway.
+                with self._lock:
+                    restore_snapshot_locked()
+                    if self._epoch == epoch:
+                        self._round_inflight = False
+                        self._attempt += 1
+                        self._user_has_contributed = False
+                raise
             except Exception:
                 # Compact the snapshot to ONE host-numpy bundle before
                 # restoring (off the training thread, outside the lock):
@@ -688,10 +715,19 @@ class Accumulator:
                 # retries later — it must never abort before the locked
                 # bookkeeping below, which would wedge _round_inflight
                 # forever (callback exceptions are swallowed upstream).
+                cancelled = None
                 if snap_parts:
                     try:
                         snap_parts = [_materialize_parts(snap_parts)]
-                    except Exception as e:
+                    except (asyncio.CancelledError,
+                            concurrent.futures.CancelledError) as e:
+                        # Never swallow cancellation — but re-raise only
+                        # AFTER the locked bookkeeping below, or
+                        # _round_inflight wedges (see comment above).
+                        cancelled = e
+                    # Guarded by the deferred-raise handler above — the
+                    # rule only sees an immediate `raise`:
+                    except Exception as e:  # moolint: disable=swallow-cancelled
                         log.error("gradient compaction failed "
                                   "(kept staged): %s", e)
                 with self._lock:
@@ -704,6 +740,8 @@ class Accumulator:
                         # The user answered this round's poll; re-open the
                         # wants_gradients window for the retry.
                         self._user_has_contributed = False
+                if cancelled is not None:
+                    raise cancelled
                 return
             # The count succeeded: materialize + sum the staged device
             # trees HERE — on the RPC completion thread, outside the lock.
@@ -717,11 +755,24 @@ class Accumulator:
             # our bundle DROPPED (the same semantics as a peer dying
             # mid-round, which the elastic protocol tolerates) — silently
             # wedging _round_inflight would stall the whole cohort.
+            cancelled = None
             if snap_parts:
                 try:
                     snap_parts = [_materialize_parts(snap_parts)]
-                except Exception as e:
-                    nonlocal snap_bs, snap_ng
+                except (asyncio.CancelledError,
+                        concurrent.futures.CancelledError) as e:
+                    # Never swallow cancellation — but the cluster already
+                    # counted our contribution, so run the same
+                    # drop-the-bundle bookkeeping as a failed readback
+                    # FIRST and re-raise after the locked section below
+                    # (aborting here would wedge _round_inflight).
+                    cancelled = e
+                    snap_parts = []
+                    snap_bs = 0
+                    snap_ng = 0
+                # Guarded by the deferred-raise handler above — the rule
+                # only sees an immediate `raise`:
+                except Exception as e:  # moolint: disable=swallow-cancelled
                     log.error(
                         "gradient readback failed; dropping %d staged "
                         "contribution(s) from this round: %s",
@@ -731,37 +782,15 @@ class Accumulator:
                     snap_bs = 0
                     snap_ng = 0
             snap_bundle = snap_parts[0] if snap_parts else None
-            with self._lock:
-                if self._epoch != epoch:
-                    # Success for a dead epoch: counts were discarded by the
-                    # reset, so re-contribute in the new epoch.
-                    restore_snapshot_locked()
-                    return
-                self._round_inflight = False
-                self._seq = seq + 1
-                # A count round resolved the current wants_gradients poll;
-                # peers may contribute again toward the (still unfilled)
-                # virtual batch — all-skip cycles must not livelock
-                # (reference: wantsGradients re-arms each cycle,
-                # src/moolib.cc:1645-1862).
-                self._user_has_contributed = False
-                self._committed_bundle = _tree_add(
-                    self._committed_bundle, snap_bundle
+            try:
+                self._commit_count_round_locked(
+                    epoch, seq, snap_bundle, snap_bs, snap_ng,
+                    restore_snapshot_locked,
+                    total_bs, all_templ, eff_vbs, neg_chunk,
                 )
-                self._committed_bs += snap_bs
-                self._committed_ngrads += snap_ng
-                self._cumulative_bs += total_bs
-                # eff_vbs and all_templ are identical on every member
-                # (they came out of the allreduce), so every member makes
-                # the same trigger decision and picks the same wire format
-                # — regardless of when a local set_virtual_batch_size call
-                # landed relative to this completion.
-                self._neg_chunk = neg_chunk
-                if eff_vbs <= self._cumulative_bs:
-                    self._start_grad_round(
-                        self._cumulative_bs, chunked=bool(all_templ),
-                        chunk_bytes=neg_chunk,
-                    )
+            finally:
+                if cancelled is not None:
+                    raise cancelled
 
         try:
             fut = self.group.all_reduce(
@@ -776,6 +805,44 @@ class Accumulator:
                 self._round_inflight = False
             return
         fut.add_done_callback(done)
+
+    def _commit_count_round_locked(self, epoch, seq, snap_bundle, snap_bs,
+                                   snap_ng, restore_snapshot_locked,
+                                   total_bs, all_templ, eff_vbs, neg_chunk):
+        """Locked tail of a successful count round: commit the snapshot,
+        advance the sequence, and trigger the gradient round when the
+        allreduced cumulative count crosses the virtual batch size."""
+        with self._lock:
+            if self._epoch != epoch:
+                # Success for a dead epoch: counts were discarded by the
+                # reset, so re-contribute in the new epoch.
+                restore_snapshot_locked()
+                return
+            self._round_inflight = False
+            self._seq = seq + 1
+            # A count round resolved the current wants_gradients poll;
+            # peers may contribute again toward the (still unfilled)
+            # virtual batch — all-skip cycles must not livelock
+            # (reference: wantsGradients re-arms each cycle,
+            # src/moolib.cc:1645-1862).
+            self._user_has_contributed = False
+            self._committed_bundle = _tree_add(
+                self._committed_bundle, snap_bundle
+            )
+            self._committed_bs += snap_bs
+            self._committed_ngrads += snap_ng
+            self._cumulative_bs += total_bs
+            # eff_vbs and all_templ are identical on every member
+            # (they came out of the allreduce), so every member makes
+            # the same trigger decision and picks the same wire format
+            # — regardless of when a local set_virtual_batch_size call
+            # landed relative to this completion.
+            self._neg_chunk = neg_chunk
+            if eff_vbs <= self._cumulative_bs:
+                self._start_grad_round(
+                    self._cumulative_bs, chunked=bool(all_templ),
+                    chunk_bytes=neg_chunk,
+                )
 
     def _release_ready_locked(self):
         """Release contiguous settled rounds to the user, in gseq order.
@@ -841,6 +908,19 @@ class Accumulator:
                     total_bundle = res["b"] if total_ng > 0 else None
                 else:
                     total_bundle, total_ng = fut.result(timeout=0)
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                # Cancelled mid-reduction (membership change): settle this
+                # round as failed so the release cursor keeps up with the
+                # cluster, mark for resync, then PROPAGATE the
+                # cancellation instead of eating it.
+                with self._lock:
+                    if self._epoch == epoch:
+                        settle_locked(None)
+                        if self._set_state is not None \
+                                and not self.is_leader():
+                            self._synced = False
+                raise
             except Exception as e:
                 with self._lock:
                     if self._epoch == epoch:

@@ -17,6 +17,8 @@ tree (its only collective), so the TPU build strictly dominates it.
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Union
@@ -418,6 +420,13 @@ class Group:
             def cb(fut):
                 try:
                     res = fut.result(timeout=0)
+                except (asyncio.CancelledError,
+                        concurrent.futures.CancelledError) as e:
+                    # A cancelled sub-op cancels the whole chunked reduce:
+                    # fail the parent, then PROPAGATE (never swallow
+                    # cancellation — the invoker decides what it means).
+                    parent._set_exception(e)
+                    raise
                 except Exception as e:
                     parent._set_exception(e)
                     return
@@ -433,6 +442,14 @@ class Group:
                     def finish():
                         try:
                             result = reassemble()
+                        except (asyncio.CancelledError,
+                                concurrent.futures.CancelledError) as e:
+                            # Merge-pool cancellation: fail the parent so
+                            # waiters wake, and re-raise.
+                            _completion_executor().submit(
+                                parent._set_exception, e
+                            )
+                            raise
                         except Exception as e:  # defensive: shape mismatch
                             _completion_executor().submit(
                                 parent._set_exception, e

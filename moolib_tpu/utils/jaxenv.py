@@ -1,4 +1,4 @@
-"""JAX platform-selection guard for entry points.
+"""JAX platform-selection guard and version-compat shims for entry points.
 
 Some deployment environments install a PJRT plugin whose registration hook
 initializes its (possibly remote) backend from ``jax.backends()`` even when
@@ -7,13 +7,48 @@ block on an unreachable accelerator tunnel during ``jax.devices()``.
 Mirroring the env var into ``jax.config`` before first backend access makes
 the restriction authoritative. Every CLI entry point that touches jax calls
 :func:`ensure_platforms` first; library code never needs to.
+
+:func:`shard_map` papers over the API move from
+``jax.experimental.shard_map`` (jax 0.4.x) to top-level ``jax.shard_map``
+— the deployed fleet spans both. jax itself stays lazily imported so
+control-plane-only processes never initialize XLA.
 """
 
 from __future__ import annotations
 
 import os
 
-__all__ = ["ensure_platforms"]
+__all__ = ["ensure_platforms", "shard_map", "axis_size"]
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` with the jax 0.4.x fallback
+    (``psum(1, axis)`` — same value, computed collectively)."""
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...)`` with a
+    fallback to ``jax.experimental.shard_map`` on jax 0.4.x, where the
+    top-level name does not exist yet (identical call convention).
+
+    The fallback disables ``check_rep``: the experimental version's static
+    replication inference cannot see through psum-producing collectives
+    this codebase uses (the newer vma typing can), and rejects out_specs
+    that are in fact replicated."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+
+        kwargs.setdefault("check_rep", False)
+    return sm(f, **kwargs)
 
 
 def ensure_platforms() -> None:

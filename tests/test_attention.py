@@ -24,6 +24,7 @@ from moolib_tpu.ops.ring_attention import (
     sequence_sharded_attention,
 )
 from moolib_tpu.parallel.mesh import make_mesh
+from moolib_tpu.utils.jaxenv import shard_map
 
 
 def _qkv(rng, B=2, H=3, T=64, D=16, dtype=np.float32):
@@ -111,7 +112,7 @@ def test_ring_gradients(rng):
     spec = P(None, None, "sp", None)
 
     def ring_loss(q):
-        f = jax.shard_map(
+        f = shard_map(
             lambda q, k, v: ring_attention(q, k, v, causal=True),
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
         )
@@ -344,7 +345,7 @@ def test_transformer_zigzag_backend_matches_dense():
         return l, b
 
     l_z, b_z = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(P(), P("sp"), P("sp"), P(None, "sp"), P("sp")),
             out_specs=(P("sp"), P("sp")),
@@ -414,7 +415,7 @@ def test_transformer_zigzag_training_keeps_sharded_layout():
         return jax.lax.psum(s, "sp") / (T * B * A)
 
     def zig_loss(params):
-        return jax.shard_map(
+        return shard_map(
             shard_loss, mesh=mesh,
             in_specs=(P(), P("sp"), P("sp"), P(None, "sp"), P("sp")),
             out_specs=P(),

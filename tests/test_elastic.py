@@ -23,6 +23,14 @@ def _peer(broker_addr, savedir, extra=()):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # conftest set cpu in-process only
     env["JAX_PLATFORMS"] = "cpu"
+    # conftest also exports an 8-virtual-device XLA_FLAGS for the
+    # in-process sharding tests; a subprocess learner sharding over 8
+    # fake CPU devices (plus actor processes) on a small container makes
+    # zero training progress. Peers run plain single-device CPU.
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
     cmd = [
         sys.executable, "-m", "moolib_tpu.examples.vtrace.experiment",
         f"broker={broker_addr}",

@@ -1,0 +1,519 @@
+"""moolint: tier-1 enforcement + engine/rule unit tests.
+
+The tier-1 contract (ISSUE 1): the full rule suite over ``moolib_tpu/``
+must be clean against the checked-in baseline — every NEW finding fails
+this test, pre-existing ones are grandfathered in
+``moolib_tpu/analysis/baseline.json``. If the baseline file is missing
+(fresh clone mid-bootstrap) the enforcement test SKIPS rather than errors.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from moolib_tpu.analysis import (
+    RecompileBudgetExceeded,
+    diff_against_baseline,
+    findings_to_baseline,
+    guarded_jit,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    recompile_budget,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+PACKAGE = REPO_ROOT / "moolib_tpu"
+BASELINE = PACKAGE / "analysis" / "baseline.json"
+MOOLINT = REPO_ROOT / "tools" / "moolint.py"
+
+
+def _lint(src, only=None):
+    return lint_source(textwrap.dedent(src), "scratch.py", only=only)
+
+
+def _rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- tier-1 enforcement -------------------------------------------------------
+
+
+def test_package_clean_against_baseline():
+    """THE enforcement test: no new findings vs the checked-in baseline."""
+    if not BASELINE.exists():
+        pytest.skip("no lint baseline checked in; run "
+                    "`python tools/moolint.py --baseline-update`")
+    findings = lint_paths([PACKAGE], root=REPO_ROOT)
+    new, _fixed = diff_against_baseline(findings, load_baseline(BASELINE))
+    assert not new, (
+        "new moolint findings (fix them or, if truly pre-existing, "
+        "re-baseline with `python tools/moolint.py --baseline-update`):\n"
+        + "\n".join(str(f) for f in new)
+    )
+
+
+def test_cli_clean_tree_exits_zero():
+    if not BASELINE.exists():
+        pytest.skip("no lint baseline checked in")
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--check", str(PACKAGE)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_seeded_violation_exits_nonzero(tmp_path):
+    """A scratch file with `time.sleep` inside `async def` must flip the
+    CLI red (the acceptance-criteria scenario)."""
+    bad = tmp_path / "scratch.py"
+    bad.write_text(
+        "import asyncio\nimport time\n\n"
+        "async def handler():\n    time.sleep(1)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), str(bad)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "async-blocking-call" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--json", str(bad)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    data = json.loads(proc.stdout)
+    assert proc.returncode == 1
+    assert [f["rule"] for f in data["new"]] == ["async-blocking-call"]
+
+
+# -- rule: swallow-cancelled --------------------------------------------------
+
+
+def test_swallow_cancelled_flags_broad_except():
+    findings = _lint(
+        """
+        import asyncio
+
+        def done(fut):
+            try:
+                fut.result(timeout=0)
+            except Exception:
+                pass
+        """
+    )
+    assert "swallow-cancelled" in _rules_of(findings)
+
+
+def test_swallow_cancelled_ok_with_guard_or_reraise():
+    clean = _lint(
+        """
+        import asyncio
+
+        def done(fut):
+            try:
+                fut.result(timeout=0)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+
+        def other(fut):
+            try:
+                fut.result(timeout=0)
+            except BaseException:
+                cleanup()
+                raise
+        """
+    )
+    assert "swallow-cancelled" not in _rules_of(clean)
+
+
+def test_swallow_cancelled_skips_non_concurrent_modules():
+    clean = _lint(
+        """
+        def parse(x):
+            try:
+                return int(x)
+            except Exception:
+                return None
+        """
+    )
+    assert clean == []
+
+
+# -- rule: async-blocking-call ------------------------------------------------
+
+
+def test_async_blocking_flags_sleep_and_untimed_result():
+    findings = _lint(
+        """
+        import asyncio
+        import time
+
+        async def loop_step(fut):
+            time.sleep(0.5)
+            fut.result()
+        """
+    )
+    assert _rules_of(findings).count("async-blocking-call") == 2
+
+
+def test_async_blocking_ok_outside_async_or_with_timeout():
+    clean = _lint(
+        """
+        import asyncio
+        import time
+
+        def sync_helper(fut):
+            time.sleep(0.5)          # fine: not on the event loop
+            return fut.result()
+
+        async def loop_step(fut):
+            await asyncio.sleep(0.5)
+            fut.result(timeout=0)    # fine: non-blocking poll
+        """
+    )
+    assert "async-blocking-call" not in _rules_of(clean)
+
+
+# -- rule: lock-held-across-await ---------------------------------------------
+
+
+def test_lock_across_await_flagged():
+    findings = _lint(
+        """
+        import asyncio
+        import threading
+
+        lock = threading.Lock()
+
+        async def update(queue):
+            with lock:
+                await queue.get()
+        """
+    )
+    assert "lock-held-across-await" in _rules_of(findings)
+
+
+def test_lock_released_before_await_ok():
+    clean = _lint(
+        """
+        import asyncio
+        import threading
+
+        lock = threading.Lock()
+
+        async def update(queue, event):
+            with lock:
+                queue.append(1)
+            await event.wait()
+        """
+    )
+    assert "lock-held-across-await" not in _rules_of(clean)
+
+
+# -- rule: unawaited-coroutine ------------------------------------------------
+
+
+def test_unawaited_coroutine_flagged():
+    findings = _lint(
+        """
+        import asyncio
+
+        async def send(conn):
+            pass
+
+        def kick(conn):
+            send(conn)
+        """
+    )
+    assert "unawaited-coroutine" in _rules_of(findings)
+
+
+def test_awaited_or_scheduled_coroutine_ok():
+    clean = _lint(
+        """
+        import asyncio
+
+        async def send(conn):
+            pass
+
+        async def run(loop, conn):
+            await send(conn)
+            loop.create_task(send(conn))
+        """
+    )
+    assert "unawaited-coroutine" not in _rules_of(clean)
+
+
+# -- rule: dropped-future -----------------------------------------------------
+
+
+def test_dropped_future_flagged():
+    findings = _lint(
+        """
+        import asyncio
+
+        def fire(loop, coro, pool):
+            asyncio.run_coroutine_threadsafe(coro, loop)
+            pool.submit(print, 1)
+        """
+    )
+    assert _rules_of(findings).count("dropped-future") == 2
+
+
+def test_consumed_future_ok():
+    clean = _lint(
+        """
+        import asyncio
+
+        def fire(loop, coro, pool):
+            fut = asyncio.run_coroutine_threadsafe(coro, loop)
+            pool.submit(print, 1).add_done_callback(print)
+            return fut.result(timeout=5)
+        """
+    )
+    assert "dropped-future" not in _rules_of(clean)
+
+
+# -- rule: host-sync-in-jit ---------------------------------------------------
+
+
+def test_host_sync_in_jit_flagged():
+    findings = _lint(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            y = float(x.sum())
+            z = np.asarray(x)
+            x.block_until_ready()
+            return y, z
+        """
+    )
+    assert _rules_of(findings).count("host-sync-in-jit") == 3
+
+
+def test_host_sync_outside_jit_ok():
+    clean = _lint(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def log_metrics(x):
+            return float(np.asarray(step(x)).sum())
+        """
+    )
+    assert "host-sync-in-jit" not in _rules_of(clean)
+
+
+def test_host_sync_found_in_jit_wrapped_local_function():
+    """`jax.jit(f)` by name marks `f` traced — the learner.py idiom."""
+    findings = _lint(
+        """
+        import jax
+        import numpy as np
+
+        def make_step():
+            def step(x):
+                return np.asarray(x)
+            return jax.jit(step)
+        """
+    )
+    assert "host-sync-in-jit" in _rules_of(findings)
+
+
+# -- rule: python-random-in-jit -----------------------------------------------
+
+
+def test_python_random_in_jit_flagged():
+    findings = _lint(
+        """
+        import jax
+        import random
+        import numpy as np
+
+        @jax.jit
+        def noisy(x):
+            return x + random.random() + np.random.uniform()
+        """
+    )
+    assert _rules_of(findings).count("python-random-in-jit") == 2
+
+
+def test_jax_random_in_jit_ok():
+    clean = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def noisy(x, key):
+            return x + jax.random.normal(key, x.shape)
+        """
+    )
+    assert "python-random-in-jit" not in _rules_of(clean)
+
+
+# -- rule: jit-missing-static -------------------------------------------------
+
+
+def test_jit_missing_static_flagged():
+    findings = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def pad(x, width: int):
+            return x
+        """
+    )
+    assert "jit-missing-static" in _rules_of(findings)
+
+
+def test_jit_with_static_argnames_ok():
+    clean = _lint(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("width",))
+        def pad(x, width: int):
+            return x
+
+        @jax.jit
+        def scale(x, factor: float = 2.0):
+            return x * factor
+        """
+    )
+    assert "jit-missing-static" not in _rules_of(clean)
+
+
+# -- engine: suppressions + baseline ------------------------------------------
+
+
+def test_line_suppression_comment():
+    src = """
+    import asyncio
+    import time
+
+    async def f():
+        time.sleep(1)  # moolint: disable=async-blocking-call
+    """
+    assert _lint(src) == []
+    # The wrong rule name does NOT suppress.
+    src_wrong = src.replace("async-blocking-call", "swallow-cancelled")
+    assert "async-blocking-call" in _rules_of(_lint(src_wrong))
+
+
+def test_file_suppression_comment():
+    src = """
+    # moolint: disable-file=async-blocking-call
+    import asyncio
+    import time
+
+    async def f():
+        time.sleep(1)
+
+    async def g():
+        time.sleep(2)
+    """
+    assert _lint(src) == []
+
+
+def test_baseline_roundtrip_grandfathers_then_catches_new():
+    src = """
+    import asyncio
+    import time
+
+    async def f():
+        time.sleep(1)
+    """
+    findings = _lint(src)
+    assert len(findings) == 1
+    baseline = findings_to_baseline(findings)
+    new, fixed = diff_against_baseline(findings, baseline)
+    assert new == [] and fixed == []
+    # A second, distinct violation is new even with the first baselined.
+    more = lint_source(
+        textwrap.dedent(src) + "\n\nasync def g(fut):\n    fut.result()\n",
+        "scratch.py",
+    )
+    new, _ = diff_against_baseline(more, baseline)
+    assert [f.rule for f in new] == ["async-blocking-call"]
+    assert "fut.result()" in new[0].snippet
+
+
+def test_lint_scans_under_hidden_ancestor_but_skips_dot_subdirs(tmp_path):
+    """The hidden-dir filter applies below the scanned root only: a repo
+    checked out under a dot-directory ancestor must still lint (else the
+    tier-1 check passes vacuously), while .git/ etc. inside stay skipped."""
+    bad = "import time\n\nasync def f():\n    time.sleep(1)\n"
+    root = tmp_path / ".ci-workspace" / "pkg"
+    (root / ".git").mkdir(parents=True)
+    (root / "m.py").write_text(bad)
+    (root / ".git" / "hook.py").write_text(bad)
+    findings = lint_paths([root], root=tmp_path)
+    assert [f.rule for f in findings] == ["async-blocking-call"]
+    assert findings[0].path.endswith("m.py")
+
+
+def test_baseline_identity_survives_line_shifts():
+    src_a = ("import asyncio\nimport time\n\n"
+             "async def f():\n    time.sleep(1)\n")
+    src_b = "# a new leading comment\n\n\n" + src_a  # shifted 3 lines down
+    baseline = findings_to_baseline(lint_source(src_a, "m.py"))
+    new, fixed = diff_against_baseline(
+        lint_source(src_b, "m.py"), baseline
+    )
+    assert new == [] and fixed == []
+
+
+# -- recompile guard ----------------------------------------------------------
+
+
+def test_recompile_budget_passes_and_counts():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2)
+    with recompile_budget(f, max_compiles=1) as guard:
+        f(jnp.ones(4))
+        f(jnp.zeros(4))  # same shape/dtype: cache hit
+    assert guard.compiles == 1
+
+
+def test_recompile_budget_exceeded_raises():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2)
+    with pytest.raises(RecompileBudgetExceeded):
+        with recompile_budget(f, max_compiles=1):
+            f(jnp.ones(4))
+            f(jnp.ones(5))  # new shape: retrace + recompile
+
+
+def test_guarded_jit_counts_static_scalar_storm():
+    import jax.numpy as jnp
+
+    f = guarded_jit(lambda x, n: x * n)
+    base = f.compiles
+    f(jnp.ones(3), 1.0)
+    f(jnp.ones(3), 2.0)  # python float traced as weak array: cache hit
+    assert f.compiles - base == 1
+
+
+def test_recompile_budget_rejects_unguardable():
+    with pytest.raises(TypeError):
+        recompile_budget(lambda x: x)

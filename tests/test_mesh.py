@@ -14,6 +14,7 @@ from moolib_tpu.parallel import (
     shard_batch,
 )
 from jax.sharding import NamedSharding, PartitionSpec as P
+from moolib_tpu.utils.jaxenv import shard_map
 
 
 def test_make_mesh_shapes():
@@ -46,7 +47,7 @@ def test_psum_gradients_in_shard_map():
         return psum_gradients(grads)
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             per_device,
             mesh=mesh,
             in_specs=P("dp"),
@@ -85,7 +86,7 @@ def test_data_parallel_train_step_grads_match_single_device():
         return dp_average_grads(g)
 
     sharded_step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step,
             mesh=mesh,
             in_specs=(P(), P(None, "dp"), P(None, "dp")),

@@ -128,6 +128,31 @@ def test_impala_space_to_depth_variant():
     assert lg.shape == (T, B, A) and np.isfinite(np.asarray(lg)).all()
 
 
+def test_impala_forward_compiles_exactly_once():
+    """Trace-hygiene pin (ISSUE 1): repeated ImpalaNet forwards with
+    same-shaped inputs must hit the jit cache — any recompile here is a
+    silent TPU-pipeline stall in the acting/learning hot path."""
+    from moolib_tpu.analysis import recompile_budget
+
+    T, B, A = 2, 2, 6
+    net = ImpalaNet(num_actions=A)
+    done = jnp.zeros((T, B), bool)
+    rng = np.random.default_rng(0)
+
+    def obs():
+        return jnp.asarray(
+            rng.integers(0, 255, (T, B, 32, 32, 4)), jnp.uint8
+        )
+
+    params = net.init(jax.random.key(0), obs(), done, ())
+    apply = jax.jit(net.apply)
+    with recompile_budget(apply, max_compiles=1) as guard:
+        for _ in range(3):
+            (logits, _), _ = apply(params, obs(), done, ())
+    assert guard.compiles == 1, "ImpalaNet forward retraced on same shapes"
+    assert logits.shape == (T, B, A)
+
+
 def test_grad_flows_through_unroll():
     T, B, F, A = 4, 2, 3, 2
     net = A2CNet(num_actions=A, use_lstm=True, lstm_size=8)

@@ -12,6 +12,7 @@ import struct
 import threading
 import time
 
+import numpy as np
 import pytest
 
 from moolib_tpu.rpc import Rpc, RpcError
@@ -283,6 +284,110 @@ def test_greeting_name_collision_rejected():
         pytest.fail("restarted incarnation never accepted")
     c3.close()
     host.close()
+
+
+def _pump(accs, until, timeout=20.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for a in accs:
+            a.update()
+        if until():
+            return
+        time.sleep(interval)
+    raise TimeoutError("condition never reached; stats: "
+                       + str([a.get_gradient_stats() for a in accs]))
+
+
+def _stuck_count_op(acc, min_age):
+    """The in-flight count-round allreduce op on ``acc`` once it has been
+    stuck for ``min_age`` (i.e. is provably waiting on a frozen peer — on
+    loopback a live round completes in milliseconds)."""
+    ops = [
+        op for key, op in acc.group._active.items()
+        if "::acc.count." in key and not op.future.done()
+    ]
+    if not ops or not acc._round_inflight:
+        return None
+    op = ops[0]
+    if time.monotonic() - op.started < min_age:
+        return None
+    return op
+
+
+def test_cancelled_accumulator_reduction_propagates_and_recovers():
+    """ISSUE 1 satellite: cancelling an in-flight Accumulator reduction
+    (elastic membership churn tears rounds down exactly like this) must
+    PROPAGATE the CancelledError and restore round bookkeeping. Before the
+    moolint fixes the broad `except Exception` handlers let the
+    cancellation skip the bookkeeping entirely: `_round_inflight` wedged
+    True forever, the snapshotted contribution was lost, and the peer
+    silently stopped reducing."""
+    from moolib_tpu.parallel import Accumulator
+    from test_group import Cluster
+
+    cluster = Cluster()
+    accs = []
+    try:
+        for i in range(2):
+            rpc, g = cluster.spawn(f"p{i}")
+            accs.append(Accumulator(rpc, group=g, virtual_batch_size=4))
+        a0, a1 = accs
+        _pump(accs, lambda: all(
+            a.connected() and a.wants_gradients() for a in accs
+        ))
+
+        # Freeze p1 (stop driving its update loop — its RPC threads stay
+        # live, like a peer stalled in a long device step). p0's next count
+        # round can then never complete: a deterministic in-flight op.
+        _pump([a0], lambda: _stuck_count_op(a0, 0.4) is not None)
+        op = _stuck_count_op(a0, 0.4)
+
+        # Cancel the reduction. The fixed handlers catch BOTH cancellation
+        # classes (asyncio.CancelledError and the concurrent.futures one —
+        # distinct, Exception-derived, on this Python), restore the round
+        # bookkeeping, and RE-RAISE so the invoker's cancellation policy
+        # applies (callbacks run synchronously inside cancel()).
+        assert op.future.cancel()
+        assert not a0._round_inflight, (
+            "cancelled count round left _round_inflight wedged"
+        )
+        assert a0._attempt == 1, "cancelled round must retry under a new key"
+
+        # Contribute, let the retry snapshot it, cancel THAT round too: the
+        # snapshotted contribution must come back to pending, not vanish.
+        a0.reduce_gradients({"w": np.full((3,), 2.0)}, batch_size=2)
+        assert a0._pending_bs == 2
+        _pump([a0], lambda: _stuck_count_op(a0, 0.4) is not None)
+        assert a0._pending_bs == 0  # snapshotted into the in-flight round
+        op = _stuck_count_op(a0, 0.4)
+        assert op.future.cancel()
+        assert not a0._round_inflight
+        assert a0._pending_bs == 2, (
+            "cancelled round dropped the snapshotted contribution"
+        )
+
+        # Membership change mid-recovery: a third peer joins, the broker
+        # issues a fresh epoch, and the whole cohort must re-align and
+        # reduce for real — p0's restored contribution included.
+        rpc2, g2 = cluster.spawn("p2")
+        accs.append(Accumulator(rpc2, group=g2, virtual_batch_size=4))
+        a2 = accs[2]
+        _pump(accs, lambda: all(
+            a.connected() and a.group.sync_id == a0.group.sync_id
+            for a in accs
+        ))
+        _pump(accs, lambda: a1.wants_gradients() and a2.wants_gradients())
+        a1.reduce_gradients({"w": np.full((3,), 2.0)}, batch_size=2)
+        a2.reduce_gradients({"w": np.full((3,), 2.0)}, batch_size=2)
+        _pump(accs, lambda: all(a.has_gradients() for a in accs))
+        for a in accs:
+            mean, count = a.result_gradients()
+            # Contributions are proportional (sum 2.0 per 2 samples), so
+            # the mean is 1.0 whether the virtual batch closed at 4 or 6.
+            assert count in (4, 6), count
+            np.testing.assert_allclose(mean["w"], np.full((3,), 1.0))
+    finally:
+        cluster.close()
 
 
 def test_bootid_gates_unix_addresses():

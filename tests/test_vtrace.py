@@ -109,6 +109,32 @@ def test_from_logits_on_policy_is_td_lambda_like():
     np.testing.assert_allclose(np.asarray(out.vs), ref_vs, rtol=1e-5, atol=1e-5)
 
 
+def test_vtrace_hot_path_compiles_exactly_once():
+    """Trace-hygiene pin (ISSUE 1): the V-trace target computation sits
+    inside every learner step — repeated same-shape calls must compile
+    once, or the train step pays an XLA compile per update."""
+    from moolib_tpu.analysis import recompile_budget
+
+    T, B = 7, 5
+    rng = np.random.default_rng(0)
+    f = jax.jit(vtrace.from_importance_weights)
+
+    def args():
+        return (
+            jnp.asarray(rng.uniform(-1, 1, (T, B))),
+            jnp.full((T, B), 0.95),
+            jnp.asarray(rng.standard_normal((T, B))),
+            jnp.asarray(rng.standard_normal((T, B))),
+            jnp.asarray(rng.standard_normal(B)),
+        )
+
+    with recompile_budget(f, max_compiles=1, label="vtrace") as guard:
+        for _ in range(3):
+            out = f(*args())  # fresh values, identical shapes/dtypes
+    assert guard.compiles == 1, "V-trace retraced on same shapes"
+    assert out.vs.shape == (T, B)
+
+
 def test_jit_and_grad_flow():
     """V-trace must be jittable and fully stop-gradient."""
     T, B = 4, 2

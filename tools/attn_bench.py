@@ -71,6 +71,7 @@ def bench_backend(backend, B, H, T, D, dtype, iters, mesh=None):
     if backend == "zigzag":
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from moolib_tpu.utils.jaxenv import shard_map
         from moolib_tpu.ops.ring_attention import (
             zigzag_order, zigzag_ring_attention,
         )
@@ -85,7 +86,7 @@ def bench_backend(backend, B, H, T, D, dtype, iters, mesh=None):
 
         def grad_fn(q, k, v):
             def loss(q, k, v):
-                o = jax.shard_map(
+                o = shard_map(
                     lambda q, k, v: zigzag_ring_attention(
                         q, k, v, axis_name="sp"
                     ),

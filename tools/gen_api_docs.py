@@ -68,6 +68,8 @@ MODULES = [
     ("moolib_tpu.utils.profiling", "XLA profiler capture"),
     ("moolib_tpu.utils.flops", "analytic FLOPs accounting / MFU"),
     ("moolib_tpu.utils.nest", "nested-structure utilities"),
+    ("moolib_tpu.analysis", "moolint: async-RPC safety + JAX trace-hygiene "
+     "static analysis (tier-1 enforced)"),
     ("moolib_tpu.broker", "broker CLI (python -m moolib_tpu.broker)"),
 ]
 
@@ -138,7 +140,9 @@ def _index() -> str:
         lines.append(f"| [`{path}`](api/{fname}) | {role} |")
     lines += [
         "",
-        "Architecture overview: [design.md](design.md).",
+        "Architecture overview: [design.md](design.md). Lint rules, "
+        "suppression syntax, and the baseline workflow: "
+        "[analysis.md](analysis.md).",
         "",
         "Other entry points:",
         "",
@@ -147,6 +151,8 @@ def _index() -> str:
         "- `bench_allreduce.py` — DCN tree / ICI psum collective benchmark.",
         "- `tools/roofline.py`, `tools/perf_sweep.py`, "
         "`tools/allreduce_decomp.py` — perf analysis tooling.",
+        "- `tools/moolint.py` — static-analysis CLI; `tools/ci_check.sh` — "
+        "lint + tier-1 tests, one entrypoint.",
         "- `python -m moolib_tpu.broker` — standalone membership broker.",
         "",
     ]

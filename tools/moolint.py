@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""moolint CLI: project-native static analysis for async-RPC safety and
+JAX trace hygiene.
+
+Usage:
+    python tools/moolint.py [paths...]            # lint vs the baseline
+    python tools/moolint.py --check moolib_tpu/   # same, explicit
+    python tools/moolint.py --baseline-update     # re-grandfather findings
+    python tools/moolint.py --list-rules
+    python tools/moolint.py --json moolib_tpu/
+
+Exit codes: 0 clean against the baseline, 1 new findings, 2 usage/engine
+error. A stale baseline (entries the tree no longer has) warns but stays
+green — shrink it with --baseline-update.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from moolib_tpu.analysis.engine import (  # noqa: E402
+    LintError,
+    all_rules,
+    diff_against_baseline,
+    lint_paths,
+    list_lint_files,
+    load_baseline,
+    save_baseline,
+)
+
+DEFAULT_BASELINE = REPO_ROOT / "moolib_tpu" / "analysis" / "baseline.json"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="moolint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: moolib_tpu/)")
+    ap.add_argument("--check", action="store_true",
+                    help="explicit alias for the default lint-vs-baseline "
+                         "mode (for CI entrypoints)")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding; ignore the baseline")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="write the current findings as the new baseline")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--only", action="append", default=None, metavar="RULE",
+                    help="run only these rules (repeatable / comma lists)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            if args.as_json:
+                continue
+            print(f"{rule.name}")
+            print(f"    {rule.description}\n")
+        if args.as_json:
+            print(json.dumps(
+                [{"name": r.name, "description": r.description}
+                 for r in all_rules()], indent=1,
+            ))
+        return 0
+
+    paths = [Path(p) for p in (args.paths or [REPO_ROOT / "moolib_tpu"])]
+    only = None
+    if args.only:
+        only = [r for chunk in args.only for r in chunk.split(",") if r]
+
+    try:
+        findings = lint_paths(paths, root=REPO_ROOT, only=only)
+    except LintError as e:
+        print(f"moolint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.baseline_update:
+        args.baseline.parent.mkdir(parents=True, exist_ok=True)
+        save_baseline(args.baseline, findings)
+        print(f"moolint: baseline updated: {len(findings)} finding(s) "
+              f"-> {args.baseline}")
+        return 0
+
+    baseline = None
+    if not args.no_baseline and args.baseline.exists():
+        try:
+            baseline = load_baseline(args.baseline)
+        except LintError as e:
+            print(f"moolint: error: {e}", file=sys.stderr)
+            return 2
+    elif not args.no_baseline:
+        print(f"moolint: note: no baseline at {args.baseline}; every "
+              "finding is new (run --baseline-update to grandfather)",
+              file=sys.stderr)
+
+    if baseline is not None:
+        # Scope the comparison to the files actually linted: entries for
+        # un-linted files are neither violated nor "fixed".
+        linted = set(list_lint_files(paths, root=REPO_ROOT))
+        baseline = {
+            "version": baseline["version"],
+            "findings": [e for e in baseline.get("findings", [])
+                         if e["path"] in linted],
+        }
+    new, fixed = diff_against_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in new],
+            "fixed_baseline_entries": fixed,
+        }, indent=1))
+    else:
+        for f in new:
+            print(str(f))
+        grandfathered = len(findings) - len(new)
+        print(
+            f"moolint: {len(findings)} finding(s): {len(new)} new, "
+            f"{grandfathered} baselined"
+            + (f", {sum(e['count'] for e in fixed)} baseline entr(ies) "
+               "fixed — shrink with --baseline-update" if fixed else "")
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
