@@ -1,12 +1,29 @@
-"""moolint: project-native static analysis for async-RPC safety and JAX
-trace hygiene.
+"""moolint: project-native static analysis for async-RPC safety, JAX
+trace hygiene, sharding/collective consistency, and RPC round balance.
 
 The reference moolib's correctness invariants (no blocking in the IO loop,
 cancellation never swallowed, every future consumed) were enforced by C++
 RAII and review; this package makes the same invariant families — plus the
-TPU-specific trace-hygiene ones (no host syncs or Python RNG inside jitted
-hot paths) — self-enforcing via an AST lint suite that runs as a tier-1
-test against a checked-in baseline (``baseline.json``).
+TPU-specific ones — self-enforcing via an AST lint suite that runs as a
+tier-1 test against a checked-in baseline (``baseline.json``). Four rule
+families:
+
+- :mod:`rules_async` — async-RPC safety (swallowed cancellation, blocking
+  calls on the IO loop, locks across await, dropped futures);
+- :mod:`rules_jax` — trace hygiene (host syncs / Python RNG inside jit,
+  recompile storms from un-static scalars);
+- :mod:`rules_sharding` — sharding/collective consistency (collectives
+  over unbound mesh axes, PartitionSpecs naming absent axes, pallas
+  BlockSpecs that cannot tile, donated-buffer reuse) — mistakes that
+  otherwise only explode at trace time on a real multi-chip mesh;
+- :mod:`rules_protocol` — round/counter balance (paths through exception
+  edges that leave ``_round_inflight``-style gates elevated — the bug
+  shape PR 1 fixed by hand in ``rpc/group.py``).
+
+The sharding and protocol families lean on a small interprocedural layer
+in :mod:`engine` (per-module symbol tables + a project index, one import
+hop deep) so axis names flowing through ``parallel/mesh.py`` helpers and
+counter writes through class-local helpers resolve.
 
 Entry points:
 
@@ -23,6 +40,7 @@ tree must stay runnable from a control-plane-only process.
 from .engine import (
     Finding,
     LintError,
+    ProjectIndex,
     Rule,
     all_rules,
     diff_against_baseline,
@@ -43,6 +61,7 @@ from .recompile_guard import (
 __all__ = [
     "Finding",
     "LintError",
+    "ProjectIndex",
     "Rule",
     "all_rules",
     "diff_against_baseline",

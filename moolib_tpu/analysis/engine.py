@@ -13,6 +13,13 @@ Design (mirrors how large projects keep a lint suite adoptable):
   violations. Findings are identified by ``(path, rule, stripped source
   line)`` — not line numbers — so unrelated edits that shift code do not
   invalidate the baseline; duplicates are tracked by count.
+- Interprocedural layer: :func:`lint_paths` parses every file first and
+  hands each :class:`ModuleContext` a shared :class:`ProjectIndex`, so a
+  rule can follow a name through ONE from-import hop into another linted
+  module (e.g. resolve ``make_mesh`` axis names from
+  ``parallel/mesh.py`` while checking ``learner.py``). Resolution is
+  strictly best-effort: anything the index cannot see resolves to None
+  and the rule must stay silent rather than guess.
 """
 
 from __future__ import annotations
@@ -30,14 +37,18 @@ __all__ = [
     "Finding",
     "LintError",
     "ModuleContext",
+    "ProjectIndex",
     "Rule",
     "all_rules",
     "diff_against_baseline",
     "findings_to_baseline",
+    "iter_scoped",
+    "iter_scoped_body",
     "lint_paths",
     "lint_source",
     "load_baseline",
     "save_baseline",
+    "terminal_name",
 ]
 
 BASELINE_VERSION = 1
@@ -48,6 +59,43 @@ _SUPPRESS_FILE_RE = re.compile(r"#\s*moolint:\s*disable-file=([\w\-,]+)")
 
 class LintError(RuntimeError):
     """Unrecoverable engine error (unreadable file, bad baseline)."""
+
+
+# Nodes that open a new execution context: walks stop at their boundary.
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+
+
+def iter_scoped_body(stmts: Iterable[ast.AST]) -> Iterator[ast.AST]:
+    """Every node under the given statements without crossing into nested
+    function/class bodies or lambdas (they execute in a different
+    context). Nested defs are yielded — callers can see them — but never
+    entered. THE shared scoped-walk for all rule modules; do not grow
+    private copies (they diverge)."""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _SCOPE_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def iter_scoped(root: ast.AST) -> Iterator[ast.AST]:
+    """Like :func:`iter_scoped_body`, for one node: the root is always
+    expanded, even when it is itself a def."""
+    yield root
+    yield from iter_scoped_body(ast.iter_child_nodes(root))
+
+
+def terminal_name(node: Optional[ast.expr]) -> Optional[str]:
+    """'foo' for Name foo, 'bar' for a.b.bar; None otherwise. The shared
+    callee-name extractor for all rule modules."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -93,6 +141,21 @@ class Rule:
                        rule=self.name, message=message, snippet=snippet)
 
 
+def _module_name_of(relpath: str) -> Tuple[Optional[str], bool]:
+    """(dotted module name, is_package) for a repo-relative posix path;
+    (None, False) when the path does not look like an importable module
+    (absolute paths, ``<string>`` scratch sources, odd names)."""
+    if not relpath.endswith(".py") or relpath.startswith("/"):
+        return None, False
+    parts = relpath[:-3].split("/")
+    is_package = parts[-1] == "__init__"
+    if is_package:
+        parts = parts[:-1]
+    if not parts or not all(p.isidentifier() for p in parts):
+        return None, False
+    return ".".join(parts), is_package
+
+
 class ModuleContext:
     """One parsed module plus the derived facts rules share."""
 
@@ -104,6 +167,12 @@ class ModuleContext:
             self.tree = ast.parse(source, filename=relpath)
         except SyntaxError as e:
             raise LintError(f"{relpath}: syntax error: {e}") from None
+        self.module_name, self.is_package = _module_name_of(relpath)
+        # Every context belongs to a project; standalone contexts get a
+        # single-module one so rules never special-case its absence.
+        self.project: "ProjectIndex" = ProjectIndex()
+        self.project.add(self)
+        self._symbols: Optional[dict] = None
         self._suppressed_lines: Dict[int, set] = {}
         self._suppressed_file: set = set()
         self._scan_suppressions()
@@ -172,15 +241,124 @@ class ModuleContext:
             isinstance(n, ast.AsyncFunctionDef) for n in ast.walk(self.tree)
         )
 
+    # -- module symbol table (interprocedural layer) -------------------------
+
+    def _symbol_table(self) -> dict:
+        """Lazily-built top-level view: function defs, simple assignments,
+        and import bindings (local name -> dotted source module + original
+        name). Only MODULE-level statements — locals are a rule's job."""
+        if self._symbols is not None:
+            return self._symbols
+        functions: Dict[str, ast.AST] = {}
+        assigns: Dict[str, ast.expr] = {}
+        imports: Dict[str, Tuple[str, str]] = {}
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                functions[node.name] = node
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        assigns[t.id] = node.value
+            elif isinstance(node, ast.AnnAssign):
+                if isinstance(node.target, ast.Name) and node.value is not None:
+                    assigns[node.target.id] = node.value
+            elif isinstance(node, ast.ImportFrom):
+                mod = self._absolutize_import(node)
+                if mod is not None:
+                    for alias in node.names:
+                        if alias.name != "*":
+                            imports[alias.asname or alias.name] = (
+                                mod, alias.name
+                            )
+        self._symbols = {
+            "functions": functions, "assigns": assigns, "imports": imports
+        }
+        return self._symbols
+
+    def _absolutize_import(self, node: ast.ImportFrom) -> Optional[str]:
+        """Dotted absolute module for a from-import, resolving relative
+        levels against this module's package; None when unresolvable."""
+        if node.level == 0:
+            return node.module
+        if self.module_name is None:
+            return None
+        parts = self.module_name.split(".")
+        if not self.is_package:
+            parts = parts[:-1]
+        drop = node.level - 1
+        if drop > len(parts):
+            return None
+        if drop:
+            parts = parts[:-drop]
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts) if parts else None
+
+    @property
+    def top_functions(self) -> Dict[str, ast.AST]:
+        return self._symbol_table()["functions"]
+
+    @property
+    def top_assigns(self) -> Dict[str, ast.expr]:
+        return self._symbol_table()["assigns"]
+
+    @property
+    def import_bindings(self) -> Dict[str, Tuple[str, str]]:
+        return self._symbol_table()["imports"]
+
+
+class ProjectIndex:
+    """All modules of one lint invocation, keyed by dotted name — the
+    shared interprocedural layer. Lookups are ONE import hop deep: a name
+    visible in a module either is a local top-level def or came in through
+    a single from-import from another linted module."""
+
+    def __init__(self, contexts: Sequence[ModuleContext] = ()):
+        self.by_name: Dict[str, ModuleContext] = {}
+        for ctx in contexts:
+            self.add(ctx)
+
+    def add(self, ctx: ModuleContext):
+        if ctx.module_name is not None:
+            self.by_name[ctx.module_name] = ctx
+        ctx.project = self
+
+    def module(self, dotted: Optional[str]) -> Optional[ModuleContext]:
+        return self.by_name.get(dotted) if dotted else None
+
+    def resolve_function(
+        self, ctx: ModuleContext, name: str
+    ) -> Optional[Tuple[ModuleContext, ast.AST]]:
+        """(defining ctx, FunctionDef) for ``name`` as visible from
+        ``ctx``: a module-level def, or one from-import hop away."""
+        node = ctx.top_functions.get(name)
+        if node is not None:
+            return ctx, node
+        bound = ctx.import_bindings.get(name)
+        if bound is None:
+            return None
+        target = self.module(bound[0])
+        if target is None:
+            return None
+        node = target.top_functions.get(bound[1])
+        if node is None:
+            return None
+        return target, node
+
 
 # -- running -----------------------------------------------------------------
 
 
 def all_rules() -> List[Rule]:
-    """The full registered rule set (async-safety + JAX trace hygiene)."""
-    from . import rules_async, rules_jax
+    """The full registered rule set (async-safety + JAX trace hygiene +
+    sharding/collective consistency + RPC round/counter balance)."""
+    from . import rules_async, rules_jax, rules_protocol, rules_sharding
 
-    return [cls() for cls in rules_async.RULES + rules_jax.RULES]
+    return [
+        cls()
+        for cls in (rules_async.RULES + rules_jax.RULES
+                    + rules_sharding.RULES + rules_protocol.RULES)
+    ]
 
 
 def _select_rules(rules: Optional[Sequence[Rule]],
@@ -252,7 +430,9 @@ def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
     back to absolute paths so they can never collide with baselined ones."""
     root = Path(root) if root is not None else Path.cwd()
     selected = _select_rules(rules, only)
-    out: List[Finding] = []
+    # Phase 1: parse everything, so phase 2 rules can resolve names across
+    # modules through the shared ProjectIndex.
+    contexts: List[ModuleContext] = []
     for path in iter_py_files(paths):
         try:
             source = path.read_text(encoding="utf-8")
@@ -263,12 +443,16 @@ def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
         except ValueError:
             rel = path.resolve().as_posix()
         try:
-            ctx = ModuleContext(source, rel)
+            contexts.append(ModuleContext(source, rel))
         except LintError:
             # A file that does not parse is someone else's failure (the
             # import suite); the linter skips it rather than masking every
             # other finding behind one broken scratch file.
             continue
+    project = ProjectIndex(contexts)
+    out: List[Finding] = []
+    for ctx in contexts:
+        assert ctx.project is project
         for rule in selected:
             for f in rule.check(ctx):
                 if not ctx.suppressed(f.rule, f.line):

@@ -20,20 +20,12 @@ from __future__ import annotations
 import ast
 from typing import Iterable, List, Optional, Set
 
-from .engine import Finding, ModuleContext, Rule
+from .engine import Finding, ModuleContext, Rule, iter_scoped_body
+from .engine import terminal_name as _terminal_name
 
 __all__ = ["RULES"]
 
 _BROAD = {"Exception", "BaseException"}
-
-
-def _terminal_name(node: Optional[ast.expr]) -> Optional[str]:
-    """'foo' for Name foo, 'bar' for a.b.bar; None otherwise."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if isinstance(node, ast.Attribute):
-        return node.attr
-    return None
 
 
 def _exc_names(type_node: Optional[ast.expr]) -> List[str]:
@@ -56,18 +48,10 @@ def _catches_cancelled(handler: ast.ExceptHandler) -> bool:
     return any(n == "CancelledError" for n in _exc_names(handler.type))
 
 
-def _stmts_no_nested_defs(body) -> Iterable[ast.AST]:
-    """All nodes under ``body``, not descending into nested function/class
-    definitions or lambdas (their bodies run in a different context)."""
-    stack = list(body)
-    while stack:
-        node = stack.pop()
-        yield node
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef, ast.Lambda)):
-                continue
-            stack.append(child)
+# All nodes under a statement list, not descending into nested function/
+# class definitions or lambdas (their bodies run in a different context).
+# The engine-shared walk — kept under the historical local name.
+_stmts_no_nested_defs = iter_scoped_body
 
 
 def _reraises(handler: ast.ExceptHandler) -> bool:

@@ -23,7 +23,7 @@ import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .engine import Finding, ModuleContext, Rule
-from .rules_async import _terminal_name
+from .engine import terminal_name as _terminal_name
 
 __all__ = ["RULES"]
 

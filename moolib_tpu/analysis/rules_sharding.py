@@ -1,0 +1,804 @@
+"""Sharding/collective consistency rules.
+
+The failure class: a sharding mistake — a ``psum`` over an axis name the
+enclosing ``shard_map`` does not bind, a ``PartitionSpec`` naming an axis
+absent from the mesh, a pallas ``BlockSpec`` that cannot tile the output,
+a donated buffer read after the jitted call consumed it — only explodes at
+trace time on a real multi-chip mesh (or worse, silently corrupts data, in
+the donation case). These rules catch the statically-decidable instances
+before any TPU hour is burned, the moolint analogue of Podracer's
+"verify topology before you launch" discipline.
+
+Axis-name resolution is a module-level dataflow pass over the
+interprocedural layer in :mod:`engine`:
+
+- mesh axes come from ``Mesh(..., axis_names=(...))`` literals, followed
+  through local assignments and up to two named-call hops (so
+  ``make_mesh``/``global_mesh`` from ``parallel/mesh.py`` resolve when
+  that module is part of the lint run);
+- the axes *in scope* for a function body come from the ``shard_map``/
+  ``pmap`` call that wraps it (``mesh=`` kwarg, ``axis_name=`` kwarg).
+
+Everything is strictly best-effort: an unresolvable mesh, a computed spec,
+or a variable axis name silences the check — these rules only speak when
+the violation is provable from literals.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .engine import (
+    Finding,
+    ModuleContext,
+    Rule,
+    iter_scoped,
+    iter_scoped_body,
+    terminal_name as _terminal_name,
+)
+
+__all__ = ["RULES"]
+
+# lax collectives whose FIRST argument is the axis name.
+_AXIS_ARG0 = {"axis_index", "axis_size"}
+# lax collectives whose SECOND argument (or axis_name= kwarg) is the axis.
+_AXIS_ARG1 = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+    "all_gather", "all_to_all", "psum_scatter", "pvary", "pcast",
+}
+_COLLECTIVES = _AXIS_ARG0 | _AXIS_ARG1
+
+_MESH_CTORS = {"Mesh", "AbstractMesh"}
+_PSPEC_NAMES = {"P", "PartitionSpec"}
+
+# Mesh-returning call chains are followed this many named hops
+# (make_mesh -> Mesh literal is one; global_mesh -> make_mesh -> Mesh
+# literal is two).
+_MESH_HOPS = 2
+
+
+def _literal_strs(node: ast.expr) -> Optional[List[str]]:
+    """["a", "b"] for a literal str / tuple-or-list of strs; None if any
+    element is not a string literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+_iter_scoped = iter_scoped  # the engine-shared scoped walk
+
+
+class _Resolver:
+    """Name/mesh resolution against enclosing function scopes, the module
+    symbol table, and the project index."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+
+    def lookup(self, name: str, fn_stack: Sequence[ast.AST],
+               before_line: Optional[int] = None) -> Optional[ast.expr]:
+        """The value expression last assigned to ``name`` AT OR BEFORE the
+        use site (lexical approximation): a rebinding later in the scope
+        must not retroactively change earlier checks. With no position
+        given, the last assignment in the scope wins."""
+        scopes: List[Iterable[ast.AST]] = [
+            _iter_scoped(fn) for fn in reversed(list(fn_stack))
+        ]
+        scopes.append(iter_scoped_body(self.ctx.tree.body))
+        for nodes in scopes:
+            found: Optional[ast.stmt] = None
+            for n in nodes:
+                value: Optional[ast.expr] = None
+                if isinstance(n, ast.Assign):
+                    if any(isinstance(t, ast.Name) and t.id == name
+                           for t in n.targets):
+                        value = n.value
+                elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                    if isinstance(n.target, ast.Name) \
+                            and n.target.id == name:
+                        value = n.value
+                if value is None:
+                    continue
+                if before_line is not None and n.lineno > before_line:
+                    continue
+                if found is None or n.lineno >= found.lineno:
+                    found = n
+            if found is not None:
+                return found.value
+        return None
+
+    def local_function(self, name: str,
+                       fn_stack: Sequence[ast.AST]) -> Optional[ast.AST]:
+        """A def named ``name`` visible from the innermost scope. Nested
+        defs are direct children of scoped statements (``_iter_scoped``
+        deliberately does not descend INTO them), so match one level of
+        children too."""
+        for fn in reversed(list(fn_stack)):
+            for n in _iter_scoped(fn):
+                if n is not fn and isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                        ast.ClassDef)
+                ):
+                    continue  # its children live in a deeper scope
+                for child in ast.iter_child_nodes(n):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+                            and child.name == name:
+                        return child
+        return self.ctx.top_functions.get(name)
+
+    # -- mesh axis names -----------------------------------------------------
+
+    def mesh_axes(self, expr: Optional[ast.expr],
+                  fn_stack: Sequence[ast.AST],
+                  hops: int = _MESH_HOPS,
+                  _seen: Optional[Set[int]] = None) -> Optional[frozenset]:
+        """Axis names of the mesh ``expr`` evaluates to, or None when the
+        construction cannot be traced to literals."""
+        if expr is None:
+            return None
+        _seen = set() if _seen is None else _seen
+        if id(expr) in _seen:
+            return None  # assignment cycle (a = b; b = a)
+        _seen.add(id(expr))
+        if isinstance(expr, ast.Name):
+            value = self.lookup(expr.id, fn_stack,
+                                before_line=getattr(expr, "lineno", None))
+            if value is not None and value is not expr:
+                return self.mesh_axes(value, fn_stack, hops, _seen)
+            return None
+        if isinstance(expr, ast.Call):
+            axes = _mesh_ctor_axes(expr)
+            if axes is not None:
+                return axes
+            if hops <= 0:
+                return None
+            callee = _terminal_name(expr.func)
+            if callee is None:
+                return None
+            resolved = None
+            local = self.local_function(callee, fn_stack)
+            if local is not None:
+                resolved = (self.ctx, local)
+            else:
+                resolved = self.ctx.project.resolve_function(self.ctx, callee)
+            if resolved is None:
+                return None
+            def_ctx, fn = resolved
+            return _Resolver(def_ctx)._function_mesh_axes(fn, hops - 1)
+        return None
+
+    def _function_mesh_axes(self, fn: ast.AST,
+                            hops: int) -> Optional[frozenset]:
+        """Axes of the mesh a function builds: a single literal
+        ``Mesh(axis_names=...)`` anywhere in its body, else a returned
+        named call followed one more hop. Ambiguity (two different literal
+        meshes) resolves to None."""
+        found: Set[frozenset] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                axes = _mesh_ctor_axes(n)
+                if axes is not None:
+                    found.add(axes)
+        if len(found) == 1:
+            return next(iter(found))
+        if found:
+            return None
+        for n in _iter_scoped(fn):
+            if isinstance(n, ast.Return) and isinstance(n.value, ast.Call):
+                axes = self.mesh_axes(n.value, [fn], hops)
+                if axes is not None:
+                    return axes
+        return None
+
+
+def _mesh_ctor_axes(call: ast.Call) -> Optional[frozenset]:
+    if _terminal_name(call.func) not in _MESH_CTORS:
+        return None
+    names = _kwarg(call, "axis_names")
+    if names is None and len(call.args) >= 2:
+        names = call.args[1]
+    if names is None:
+        return None
+    lits = _literal_strs(names)
+    return frozenset(lits) if lits is not None else None
+
+
+def _pspec_literal_axes(expr: ast.expr) -> Iterator[Tuple[str, ast.AST]]:
+    """(axis name, P-call node) for every string literal inside any
+    ``P(...)``/``PartitionSpec(...)`` call under ``expr``."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal_name(node.func) not in _PSPEC_NAMES:
+            continue
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    yield sub.value, node
+
+
+# -- scope discovery ----------------------------------------------------------
+
+
+class _Scope:
+    """One region of code with a known set of bound mesh axis names."""
+
+    __slots__ = ("fn", "axes", "site")
+
+    def __init__(self, fn: ast.AST, axes: frozenset, site: ast.Call):
+        self.fn = fn        # FunctionDef / Lambda whose body is in scope
+        self.axes = axes    # axis names bound by the wrapping transform
+        self.site = site    # the shard_map/pmap call that binds them
+
+
+def _walk_with_fn_stack(tree: ast.AST) -> Iterator[Tuple[ast.AST, List[ast.AST]]]:
+    """(node, enclosing-function-stack) for every node, outermost first."""
+
+    def rec(node: ast.AST, stack: List[ast.AST]):
+        yield node, stack
+        is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))
+        nxt = stack + [node] if is_fn else stack
+        for child in ast.iter_child_nodes(node):
+            yield from rec(child, nxt)
+
+    yield from rec(tree, [])
+
+
+def _target_function(resolver: _Resolver, expr: ast.expr,
+                     fn_stack: Sequence[ast.AST]) -> Optional[ast.AST]:
+    """The function object a shard_map/pmap call wraps, when nameable."""
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if isinstance(expr, ast.Name):
+        return resolver.local_function(expr.id, fn_stack)
+    return None
+
+
+def _axis_scopes(ctx: ModuleContext) -> List[_Scope]:
+    """Every function body whose bound axis names are statically known:
+    shard_map targets with a resolvable mesh, pmap targets/decorations
+    with a literal ``axis_name``."""
+    resolver = _Resolver(ctx)
+    scopes: List[_Scope] = []
+    for node, stack in _walk_with_fn_stack(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = _terminal_name(node.func)
+            if name == "shard_map" and node.args:
+                axes = resolver.mesh_axes(_kwarg(node, "mesh"), stack)
+                fn = _target_function(resolver, node.args[0], stack)
+                if axes is not None and fn is not None:
+                    scopes.append(_Scope(fn, axes, node))
+            elif name == "pmap" and node.args:
+                lit = _kwarg(node, "axis_name")
+                if lit is not None:
+                    axes_l = _literal_strs(lit)
+                    fn = _target_function(resolver, node.args[0], stack)
+                    if axes_l is not None and fn is not None:
+                        scopes.append(
+                            _Scope(fn, frozenset(axes_l), node)
+                        )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and _terminal_name(dec.func) == "pmap":
+                    lit = _kwarg(dec, "axis_name")
+                    axes_l = _literal_strs(lit) if lit is not None else None
+                    if axes_l is not None:
+                        scopes.append(
+                            _Scope(node, frozenset(axes_l), dec)
+                        )
+    return scopes
+
+
+def _collective_axis_literals(
+    call: ast.Call,
+) -> Iterator[str]:
+    """Literal axis names a lax collective call names, if any."""
+    name = _terminal_name(call.func)
+    axis_expr: Optional[ast.expr] = _kwarg(call, "axis_name")
+    if axis_expr is None:
+        if name in _AXIS_ARG0 and call.args:
+            axis_expr = call.args[0]
+        elif name in _AXIS_ARG1 and len(call.args) >= 2:
+            axis_expr = call.args[1]
+    if axis_expr is None:
+        return
+    lits = _literal_strs(axis_expr)
+    if lits:
+        yield from lits
+
+
+def _helper_consumes_axis(ctx: ModuleContext, callee: str,
+                          scope: "_Scope") -> bool:
+    """True when ``callee`` resolves (locally or one import hop away) and
+    its body feeds its ``axis_name`` parameter into a collective's axis
+    position WITHOUT binding it in a transform of its own — only then does
+    the axis the caller passes have to exist in the caller's scope."""
+    resolver = _Resolver(ctx)
+    resolved = None
+    local = resolver.local_function(callee, [scope.fn])
+    if local is not None:
+        resolved = (ctx, local)
+    else:
+        resolved = ctx.project.resolve_function(ctx, callee)
+    if resolved is None:
+        return False  # cannot see into the helper: stay silent
+    _def_ctx, fn = resolved
+    args = getattr(fn, "args", None)
+    if args is None or not any(
+        a.arg == "axis_name"
+        for a in list(args.posonlyargs) + list(args.args)
+        + list(args.kwonlyargs)
+    ):
+        return False
+    binds = False
+    uses = False
+    for n in iter_scoped(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        name = _terminal_name(n.func)
+        kw = _kwarg(n, "axis_name")
+        forwards = isinstance(kw, ast.Name) and kw.id == "axis_name"
+        if name in ("shard_map", "pmap", "vmap", "xmap") and forwards:
+            binds = True
+        elif name in _COLLECTIVES:
+            axis_expr = kw
+            if axis_expr is None:
+                if name in _AXIS_ARG0 and n.args:
+                    axis_expr = n.args[0]
+                elif name in _AXIS_ARG1 and len(n.args) >= 2:
+                    axis_expr = n.args[1]
+            if isinstance(axis_expr, ast.Name) \
+                    and axis_expr.id == "axis_name":
+                uses = True
+        elif forwards:
+            uses = True  # forwarded deeper: assume consumed
+    return uses and not binds
+
+
+def _walk_skipping(root: ast.AST, skip: Set[int]) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nodes whose id is in ``skip``."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if id(node) in skip:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class CollectiveAxisUnbound(Rule):
+    name = "collective-axis-unbound"
+    description = (
+        "a lax collective (psum/pmean/ppermute/all_gather/axis_index/...) "
+        "inside a shard_map/pmap-wrapped function names a literal axis the "
+        "wrapping transform does not bind — this only fails at trace time "
+        "on the real mesh. Literal `axis_name=` kwargs to helpers are "
+        "checked too, when the helper resolvably consumes the axis in a "
+        "collective (rather than binding it in a transform of its own)."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for scope in _axis_scopes(ctx):
+            body = scope.fn.body
+            nodes = body if isinstance(body, list) else [body]
+            skip = self._nested_transform_targets(ctx, scope)
+            for root in nodes:
+                for node in _walk_skipping(root, skip):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = _terminal_name(node.func)
+                    if callee in _COLLECTIVES:
+                        for axis in _collective_axis_literals(node):
+                            if axis not in scope.axes:
+                                yield self.finding(
+                                    ctx, node,
+                                    f"collective names axis {axis!r} but the "
+                                    f"enclosing transform binds only "
+                                    f"{sorted(scope.axes)}",
+                                )
+                    elif callee not in ("shard_map", "pmap", "vmap", "xmap"):
+                        # A nested axis-binding transform (shard_map/pmap/
+                        # vmap/xmap) binds its own axis_name; its kwargs
+                        # are not checked against the outer scope. A plain
+                        # helper is only flagged when it RESOLVABLY
+                        # consumes the axis in a collective (the
+                        # ring_attention parameter style) — a helper that
+                        # binds it itself, or one we cannot see into,
+                        # stays silent.
+                        kw = _kwarg(node, "axis_name")
+                        lits = _literal_strs(kw) if kw is not None else None
+                        if lits and not _helper_consumes_axis(
+                            ctx, callee, scope
+                        ):
+                            continue
+                        for axis in lits or ():
+                            if axis not in scope.axes:
+                                yield self.finding(
+                                    ctx, node,
+                                    f"helper call passes axis_name={axis!r} "
+                                    f"but the enclosing transform binds "
+                                    f"only {sorted(scope.axes)}",
+                                )
+
+    def _nested_transform_targets(self, ctx: ModuleContext,
+                                  scope: _Scope) -> Set[int]:
+        """Subtrees inside ``scope.fn`` that a NESTED shard_map/pmap wraps:
+        their collectives answer to the inner transform's axes (checked by
+        that transform's own scope when resolvable), never the outer's."""
+        resolver = _Resolver(ctx)
+        skip: Set[int] = set()
+        for node in ast.walk(scope.fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not scope.fn:
+                # Decorator form: @pmap(axis_name=...) / @partial(jax.pmap,
+                # ...) on a nested def re-binds the execution context too.
+                if any(self._is_transform_decorator(dec)
+                       for dec in node.decorator_list):
+                    skip.add(id(node))
+                continue
+            if not isinstance(node, ast.Call) or node is scope.site:
+                continue
+            callee = _terminal_name(node.func)
+            if callee not in ("shard_map", "pmap", "vmap", "xmap"):
+                continue
+            if callee in ("vmap", "xmap") \
+                    and _kwarg(node, "axis_name") is None:
+                continue  # no new axis bound: outer scope still governs
+            if not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                skip.add(id(target))
+            elif isinstance(target, ast.Name):
+                fn = resolver.local_function(target.id, [scope.fn])
+                if fn is not None:
+                    skip.add(id(fn))
+        return skip
+
+    @staticmethod
+    def _is_transform_decorator(dec: ast.expr) -> bool:
+        names = ("shard_map", "pmap", "vmap", "xmap")
+        if _terminal_name(dec) in names:
+            return True  # bare @pmap
+        if isinstance(dec, ast.Call):
+            if _terminal_name(dec.func) in names:
+                return True
+            if _terminal_name(dec.func) == "partial" and dec.args \
+                    and _terminal_name(dec.args[0]) in names:
+                return True
+        return False
+
+
+class PartitionSpecAxisUnbound(Rule):
+    name = "pspec-axis-unbound"
+    description = (
+        "a PartitionSpec literal names a mesh axis the constructing mesh "
+        "does not have (NamedSharding(mesh, P(...)), shard_map in_specs/"
+        "out_specs): XLA rejects it only when the program first runs on "
+        "the real mesh."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        resolver = _Resolver(ctx)
+        for node, stack in _walk_with_fn_stack(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            if name == "NamedSharding" and node.args:
+                axes = resolver.mesh_axes(node.args[0], stack)
+                if axes is None or len(node.args) < 2:
+                    continue
+                spec = node.args[1]
+                if isinstance(spec, ast.Name):
+                    spec = resolver.lookup(
+                        spec.id, stack, before_line=spec.lineno
+                    ) or spec
+                for axis, pnode in _pspec_literal_axes(spec):
+                    if axis not in axes:
+                        yield self.finding(
+                            ctx, pnode,
+                            f"PartitionSpec names axis {axis!r} but the "
+                            f"mesh has only {sorted(axes)}",
+                        )
+            elif name == "shard_map":
+                axes = resolver.mesh_axes(_kwarg(node, "mesh"), stack)
+                if axes is None:
+                    continue
+                for kwname in ("in_specs", "out_specs"):
+                    spec = _kwarg(node, kwname)
+                    if spec is None:
+                        continue
+                    if isinstance(spec, ast.Name):
+                        spec = resolver.lookup(
+                            spec.id, stack, before_line=spec.lineno
+                        ) or spec
+                    for axis, pnode in _pspec_literal_axes(spec):
+                        if axis not in axes:
+                            yield self.finding(
+                                ctx, pnode,
+                                f"{kwname} PartitionSpec names axis "
+                                f"{axis!r} but the mesh has only "
+                                f"{sorted(axes)}",
+                            )
+
+
+# -- pallas BlockSpec ---------------------------------------------------------
+
+
+def _as_element_list(expr: Optional[ast.expr]) -> Optional[List[ast.expr]]:
+    if expr is None:
+        return None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return list(expr.elts)
+    return [expr]
+
+
+def _literal_dims(expr: Optional[ast.expr]) -> Optional[List[Optional[int]]]:
+    """Per-dim int-or-None for a literal shape tuple; None when the node is
+    not a tuple/list at all."""
+    if not isinstance(expr, (ast.Tuple, ast.List)):
+        return None
+    out: List[Optional[int]] = []
+    for e in expr.elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                and not isinstance(e.value, bool):
+            out.append(e.value)
+        else:
+            out.append(None)
+    return out
+
+
+class PallasBlockSpecStatic(Rule):
+    name = "pallas-blockspec-static"
+    description = (
+        "a pallas_call BlockSpec whose literal block shape cannot tile the "
+        "matching literal out_shape dims (rank mismatch, zero/negative "
+        "block dim, or a dim the block size does not divide): the kernel "
+        "fails at lowering time on real hardware."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) \
+                    or _terminal_name(node.func) != "pallas_call":
+                continue
+            specs = _as_element_list(_kwarg(node, "out_specs"))
+            shapes = _as_element_list(_kwarg(node, "out_shape"))
+            if specs is None or shapes is None or len(specs) != len(shapes):
+                continue
+            for spec, shape in zip(specs, shapes):
+                yield from self._check_pair(ctx, spec, shape)
+
+    def _check_pair(self, ctx, spec, shape) -> Iterable[Finding]:
+        if not isinstance(spec, ast.Call) \
+                or _terminal_name(spec.func) != "BlockSpec":
+            return
+        block_expr = _kwarg(spec, "block_shape")
+        if block_expr is None and spec.args:
+            block_expr = spec.args[0]
+        block = _literal_dims(block_expr)
+        shape_expr = None
+        if isinstance(shape, ast.Call) \
+                and _terminal_name(shape.func) == "ShapeDtypeStruct":
+            shape_expr = _kwarg(shape, "shape")
+            if shape_expr is None and shape.args:
+                shape_expr = shape.args[0]
+        dims = _literal_dims(shape_expr) if shape_expr is not None else None
+        if block is None:
+            return
+        for b in block:
+            if b is not None and b <= 0:
+                yield self.finding(
+                    ctx, spec,
+                    f"BlockSpec block dim {b} is not positive",
+                )
+                return
+        if dims is None:
+            return
+        if len(block) != len(dims):
+            yield self.finding(
+                ctx, spec,
+                f"BlockSpec rank {len(block)} != array rank {len(dims)}",
+            )
+            return
+        for i, (b, d) in enumerate(zip(block, dims)):
+            if b is not None and d is not None and b > 0 and d % b:
+                yield self.finding(
+                    ctx, spec,
+                    f"block dim {b} does not divide array dim {d} "
+                    f"(axis {i}): pallas cannot tile this output",
+                )
+
+
+# -- donated buffers ----------------------------------------------------------
+
+
+def _donate_spec_positions(spec: Optional[ast.expr]) -> Optional[Set[int]]:
+    """Donated positional indices from a literal donate_argnums value, or
+    None when absent/non-literal (conditional donation etc. — stay
+    silent)."""
+    if spec is None:
+        return None
+    if isinstance(spec, ast.Constant) and isinstance(spec.value, int) \
+            and not isinstance(spec.value, bool):
+        return {spec.value}
+    dims = _literal_dims(spec)
+    if dims is None or any(d is None for d in dims):
+        return None
+    return set(dims)  # type: ignore[arg-type]
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """Donated positional indices declared by a direct jit/pjit call."""
+    if _terminal_name(call.func) not in ("jit", "pjit"):
+        return None
+    return _donate_spec_positions(_kwarg(call, "donate_argnums"))
+
+
+def _collect_donating_callables(ctx: ModuleContext) -> Dict[str, Set[int]]:
+    """Names bound to jit-with-literal-donation callables anywhere in the
+    module: ``f = jax.jit(g, donate_argnums=...)`` assignments and
+    ``@partial(jax.jit, donate_argnums=...)`` decorated defs."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            donated = _donated_positions(node.value)
+            if donated:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = donated
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and _terminal_name(dec.func) == "partial" \
+                        and dec.args and _terminal_name(dec.args[0]) in (
+                            "jit", "pjit"):
+                    # partial() forwards its kwargs to jit: read the donate
+                    # spec off the partial call itself.
+                    donated = _donate_spec_positions(
+                        _kwarg(dec, "donate_argnums")
+                    )
+                    if donated:
+                        out[node.name] = donated
+    return out
+
+
+class DonatedBufferReuse(Rule):
+    name = "donated-buffer-reuse"
+    description = (
+        "an argument donated to a jitted call (donate_argnums) is read "
+        "again after the call: XLA has already reused its buffer for the "
+        "output, so the read returns garbage (or a deleted-array error). "
+        "Rebind the name to the result, or drop the donation."
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        donating = _collect_donating_callables(ctx)
+        if not donating:
+            return
+        bodies: List[List[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bodies.append(node.body)
+        for body in bodies:
+            yield from self._scan(ctx, body, donating)
+
+    def _scan(self, ctx, body, donating,
+              watched: Optional[Dict[str, ast.Call]] = None
+              ) -> Iterable[Finding]:
+        """Statement-order scan of one block. Loop bodies share the watch
+        set (a donation on one line poisons reads on the next iteration's
+        lexical successors); exclusive branches (if/else, try handlers)
+        scan against their OWN copy and re-join by union, so a donation in
+        one branch never flags a read in its sibling. Simple statements
+        are atomic: reads check the PRE-statement watches, its own stores
+        then clear, its own donated calls then arm."""
+        watched = {} if watched is None else watched
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                yield from self._scan_simple(
+                    ctx, [stmt.test], donating, watched
+                )
+                branches = [dict(watched), dict(watched)]
+                yield from self._scan(ctx, stmt.body, donating, branches[0])
+                yield from self._scan(ctx, stmt.orelse, donating, branches[1])
+                watched.clear()
+                for b in branches:
+                    watched.update(b)  # may-donate join
+            elif isinstance(stmt, ast.Try):
+                entry = dict(watched)
+                branches = [watched]  # body mutates the main dict
+                yield from self._scan(ctx, stmt.body, donating, watched)
+                yield from self._scan(ctx, stmt.orelse, donating, watched)
+                for handler in stmt.handlers:
+                    hw = dict(entry)  # handler may run before any donation
+                    branches.append(hw)
+                    yield from self._scan(ctx, handler.body, donating, hw)
+                merged: Dict[str, ast.Call] = {}
+                for b in branches:
+                    merged.update(b)
+                watched.clear()
+                watched.update(merged)
+                yield from self._scan(ctx, stmt.finalbody, donating, watched)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While,
+                                   ast.With, ast.AsyncWith)):
+                headers = [
+                    n for n in ast.iter_child_nodes(stmt)
+                    if not isinstance(n, ast.stmt)
+                ]
+                yield from self._scan_simple(ctx, headers, donating, watched)
+                yield from self._scan(ctx, stmt.body, donating, watched)
+                yield from self._scan(
+                    ctx, getattr(stmt, "orelse", []), donating, watched
+                )
+            else:
+                yield from self._scan_simple(ctx, [stmt], donating, watched)
+
+    def _scan_simple(self, ctx, nodes, donating, watched
+                     ) -> Iterable[Finding]:
+        # Reads in these nodes against buffers donated earlier.
+        if watched:
+            for root in nodes:
+                for node in ast.walk(root):
+                    if isinstance(node, ast.Name) \
+                            and isinstance(node.ctx, ast.Load) \
+                            and node.id in watched:
+                        yield self.finding(
+                            ctx, node,
+                            f"{node.id!r} was donated to the jitted call on "
+                            f"line {watched[node.id].lineno} and may no "
+                            "longer hold live data",
+                        )
+                        del watched[node.id]
+        stores = {
+            n.id for root in nodes for n in ast.walk(root)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+        }
+        calls_watch: Dict[str, ast.Call] = {}
+        for root in nodes:
+            for node in ast.walk(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _terminal_name(node.func)
+                if callee in donating:
+                    for idx in donating[callee]:
+                        if idx < len(node.args) and isinstance(
+                            node.args[idx], ast.Name
+                        ):
+                            calls_watch[node.args[idx].id] = node
+        for name in stores:
+            watched.pop(name, None)
+            calls_watch.pop(name, None)
+        watched.update(calls_watch)
+
+
+RULES = [
+    CollectiveAxisUnbound,
+    PartitionSpecAxisUnbound,
+    PallasBlockSpecStatic,
+    DonatedBufferReuse,
+]

@@ -32,6 +32,8 @@ in the worker: on done, the returned obs is the first obs of the next episode
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import pickle
 import threading
 import time
@@ -71,6 +73,8 @@ def _get_native():
         from ..native import get_native
 
         return get_native()
+    except (asyncio.CancelledError, concurrent.futures.CancelledError):
+        raise  # never swallow task cancellation
     except Exception:
         return None
 
@@ -272,6 +276,8 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
     except Exception as e:  # report, then die; parent surfaces it
         try:
             conn.send(("error", f"{type(e).__name__}: {e}"))
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            raise  # cancellation outranks best-effort error reporting
         except Exception:
             pass
         raise
@@ -279,6 +285,9 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
         for e in envs:
             try:
                 e.close()
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # never swallow cancellation, even in teardown
             except Exception:
                 pass
 
@@ -638,6 +647,13 @@ class EnvPool:
                             fired = self._callbacks.pop(payload, None)
                     if fired:
                         self._run_callbacks(fired)
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            # Cancellation of the drain thread: wake every waiter (their
+            # result() sees the recorded error), then PROPAGATE — the
+            # invoker decides what cancellation means.
+            self._waiter_error = self._waiter_error or "drain loop cancelled"
+            self._fail_all_waiters()
+            raise
         except Exception as e:
             self._waiter_error = f"{type(e).__name__}: {e}"
             self._fail_all_waiters()
@@ -709,6 +725,12 @@ class EnvPool:
                     except RuntimeError:
                         self._fail_all_waiters()
                         return
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            # Same contract as _drain_loop: restore waiter liveness, then
+            # propagate the cancellation instead of eating it.
+            self._waiter_error = self._waiter_error or "notify loop cancelled"
+            self._fail_all_waiters()
+            raise
         except Exception as e:
             self._waiter_error = f"{type(e).__name__}: {e}"
             self._fail_all_waiters()
@@ -717,6 +739,9 @@ class EnvPool:
         for fn, fut in items:
             try:
                 fn(fut)
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # a cancelled callback cancels the dispatch loop
             except Exception as e:
                 log.error("env step callback failed: %s", e)
 
@@ -769,6 +794,9 @@ class EnvPool:
                     self._native.sem_post(
                         self._shm.buf, self._ctrl.notify_sem
                     )
+                except (asyncio.CancelledError,
+                        concurrent.futures.CancelledError):
+                    raise  # never swallow cancellation, even in teardown
                 except Exception:
                     pass
             for w in range(self.num_processes):
@@ -824,6 +852,8 @@ class EnvPool:
     def __del__(self):
         try:
             self.close()
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            raise  # surfaced as an unraisable warning, never silently eaten
         except Exception:
             pass
 

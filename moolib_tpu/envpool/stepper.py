@@ -28,6 +28,8 @@ overlap from its bufferBusy rotation, src/env.cc:273-349).
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import threading
 import time
 from typing import Optional
@@ -176,6 +178,12 @@ class EnvPoolServer:
         def on_done(f):
             try:
                 deferred(f.result(timeout=0))
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError) as e:
+                # Tell the waiting client the step died, then PROPAGATE
+                # the cancellation instead of eating it.
+                deferred.error(f"{type(e).__name__}: step cancelled")
+                raise
             except Exception as e:
                 deferred.error(f"{type(e).__name__}: {e}")
 
@@ -185,6 +193,9 @@ class EnvPoolServer:
         for fn in ("info", "acquire", "release", "step"):
             try:
                 self.rpc.undefine(f"{self.name}::{fn}")
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # never swallow cancellation, even in teardown
             except Exception:
                 pass
 
@@ -228,5 +239,8 @@ class RemoteEnvStepper:
                     self.server, f"{self.name}::release", self.batch_index,
                     self.rpc.get_name(),
                 ).result(10.0)
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # cancellation propagates; lease expiry reclaims
             except Exception:
                 pass  # server gone: buffer dies with it

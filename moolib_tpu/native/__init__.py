@@ -11,6 +11,8 @@ Set ``MOOLIB_TPU_NO_NATIVE=1`` to force the pure-Python paths.
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import importlib.util
 import os
 import subprocess
@@ -99,6 +101,9 @@ def get_native():
                 spec.loader.exec_module(mod)
                 sys.modules["moolib_tpu.native._native"] = mod
                 _module = mod
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # never swallow task cancellation
             except Exception as e:  # corrupt cache, ABI mismatch, ...
                 log.info("native load failed (%s); rebuilding once", e)
                 so = build_native(force=True)
@@ -110,6 +115,9 @@ def get_native():
                         mod = importlib.util.module_from_spec(spec)
                         spec.loader.exec_module(mod)
                         _module = mod
+                    except (asyncio.CancelledError,
+                            concurrent.futures.CancelledError):
+                        raise
                     except Exception:
                         _module = None
         _cached = True

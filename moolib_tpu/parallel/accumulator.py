@@ -103,6 +103,8 @@ def _tree_is_ready(tree) -> bool:
         try:
             if not ready():
                 return False
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            raise  # never swallow task cancellation
         except Exception:
             return False
     return True
@@ -567,9 +569,16 @@ class Accumulator:
                     log.info("%s: state synced at v%d",
                              self.rpc.get_name(), version)
 
-        self.rpc.async_callback(
-            leader, "AccumulatorService::requestState", on_state
-        )
+        try:
+            self.rpc.async_callback(
+                leader, "AccumulatorService::requestState", on_state
+            )
+        except BaseException:
+            # Synchronous dispatch failure: without this restore the
+            # request gate wedges and the peer never re-requests state
+            # (on_state will never run to clear it).
+            self._state_req_inflight = False
+            raise
 
     def _maybe_broadcast_state(self):
         """Leader-side periodic full-state re-push to every member

@@ -247,11 +247,18 @@ class Group:
                 if error is not None:
                     log.debug("broker ping failed: %s", error)
 
-            self.rpc.async_callback(
-                self.broker_name, "BrokerService::ping", on_pong,
-                self.group_name, self.rpc.get_name(), self.timeout,
-                self._sync_id, self.sort_order,
-            )
+            try:
+                self.rpc.async_callback(
+                    self.broker_name, "BrokerService::ping", on_pong,
+                    self.group_name, self.rpc.get_name(), self.timeout,
+                    self._sync_id, self.sort_order,
+                )
+            except BaseException:
+                # Synchronous dispatch failure (closing rpc, bad peer):
+                # re-open the ping gate or membership never recovers —
+                # on_pong will never run to clear it.
+                self._ping_inflight = False
+                raise
         self._expire_ops()
 
     def _apply_sync(self, sync_id: str, members: List[str]):

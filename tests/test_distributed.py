@@ -10,6 +10,15 @@ import textwrap
 
 import pytest
 
+from conftest import has_multiprocess_cpu_collectives
+
+pytestmark = pytest.mark.skipif(
+    not has_multiprocess_cpu_collectives(),
+    reason="this jaxlib cannot run multiprocess computations on the CPU "
+           "backend (no cpu-collectives support / "
+           "jax_cpu_collectives_implementation config; needs jax >= 0.5)",
+)
+
 _WORKER = textwrap.dedent(
     """
     import os, sys
@@ -86,6 +95,9 @@ def test_two_process_distributed_train_step(tmp_path):
     env["REPO"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # On jax builds that support CPU collectives (the skipif gate above),
+    # select the gloo transport explicitly — the default is process-local.
+    env.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), str(rank), "2", coord],

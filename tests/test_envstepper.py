@@ -2,6 +2,7 @@
 (reference topology: src/env.cc:176-249 — one env server, many stepper
 clients, each owning a buffer and overlapping with the others)."""
 
+import concurrent.futures
 import threading
 
 import numpy as np
@@ -97,6 +98,9 @@ def test_concurrent_clients_from_threads(served_pool):
                     st.step(np.zeros(4, np.int64)).result(timeout=60)
                 )
             results[name] = outs
+        except concurrent.futures.CancelledError as e:
+            errors.append((name, e))
+            raise  # recorded for the assertion below, but never swallowed
         except Exception as e:  # surfaced below
             errors.append((name, e))
         finally:

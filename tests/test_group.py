@@ -2,6 +2,7 @@
 over loopback (reference strategy: test/test_reduce.py:18-130,
 test/test_group.py, test/unit/test_broker.py)."""
 
+import concurrent.futures
 import threading
 import time
 
@@ -289,6 +290,8 @@ def test_broker_restart_group_recovers(cluster):
             new_rpc = Rpc("broker")
             new_rpc.listen(addr)
             break
+        except concurrent.futures.CancelledError:
+            raise  # never swallow cancellation
         except Exception:
             new_rpc.close()
             new_rpc = None
@@ -356,6 +359,9 @@ def test_randomized_churn_allreduce_property(cluster):
                 if fut.op_key.startswith(s + "."):
                     results[i].append((m_epoch, float(out[0])))
                 time.sleep(0.02)
+        except concurrent.futures.CancelledError as e:
+            errors.append((i, repr(e)))
+            raise  # recorded for the assertion below, but never swallowed
         except Exception as e:  # pragma: no cover - surfaced below
             errors.append((i, repr(e)))
 

@@ -24,11 +24,13 @@ from moolib_tpu.analysis import (
     lint_source,
     load_baseline,
     recompile_budget,
+    save_baseline,
 )
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 PACKAGE = REPO_ROOT / "moolib_tpu"
 BASELINE = PACKAGE / "analysis" / "baseline.json"
+BASELINE_TOOLS = PACKAGE / "analysis" / "baseline_tools.json"
 MOOLINT = REPO_ROOT / "tools" / "moolint.py"
 
 
@@ -65,6 +67,20 @@ def test_cli_clean_tree_exits_zero():
         capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_tools_and_tests_trees_clean():
+    """The non-package trees are enforced against their own (empty unless
+    debt accrues) baseline — the second ci_check.sh lint stage."""
+    if not BASELINE_TOOLS.exists():
+        pytest.skip("no tools/tests lint baseline checked in")
+    findings = lint_paths(
+        [REPO_ROOT / "tools", REPO_ROOT / "tests"], root=REPO_ROOT
+    )
+    new, _fixed = diff_against_baseline(
+        findings, load_baseline(BASELINE_TOOLS)
+    )
+    assert not new, "\n".join(str(f) for f in new)
 
 
 def test_cli_seeded_violation_exits_nonzero(tmp_path):
@@ -399,6 +415,865 @@ def test_jit_with_static_argnames_ok():
     assert "jit-missing-static" not in _rules_of(clean)
 
 
+# -- rule family: sharding/collective consistency -----------------------------
+
+
+def test_collective_axis_unbound_flagged():
+    findings = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp",))
+
+        def f(x):
+            return jax.lax.psum(x, "tp")
+
+        g = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        """
+    )
+    assert "collective-axis-unbound" in _rules_of(findings)
+
+
+def test_collective_axis_bound_ok():
+    clean = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp", "tp"))
+
+        def f(x):
+            return jax.lax.psum(jax.lax.pmean(x, "tp"), "dp")
+
+        g = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        """
+    )
+    assert "collective-axis-unbound" not in _rules_of(clean)
+
+
+def test_collective_axis_variable_name_stays_silent():
+    """A non-literal axis (the ring_attention idiom) must not be guessed."""
+    clean = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp",))
+
+        def f(x, axis_name="sp"):
+            return jax.lax.psum(x, axis_name)
+
+        g = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        """
+    )
+    assert "collective-axis-unbound" not in _rules_of(clean)
+
+
+def test_collective_axis_through_local_mesh_helper():
+    findings = _lint(
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        def make_mesh(devs):
+            arr = np.asarray(devs).reshape(-1, 1)
+            return Mesh(arr, axis_names=("dp", "tp"))
+
+        mesh = make_mesh(devs)
+
+        def f(x):
+            return jax.lax.pmean(x, "sp")
+
+        g = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P())
+        """
+    )
+    assert "collective-axis-unbound" in _rules_of(findings)
+
+
+def test_collective_axis_through_imported_mesh_helper(tmp_path):
+    """The interprocedural layer: make_mesh defined in a SEPARATE linted
+    module resolves through the project index (one from-import hop)."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "meshes.py").write_text(textwrap.dedent(
+        """
+        import numpy as np
+        from jax.sharding import Mesh
+
+        def make_mesh(devs):
+            arr = np.asarray(devs).reshape(-1, 1)
+            return Mesh(arr, axis_names=("dp", "tp"))
+        """
+    ))
+    (pkg / "user.py").write_text(textwrap.dedent(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from pkg.meshes import make_mesh
+
+        mesh = make_mesh(devs)
+
+        def f(x):
+            return jax.lax.psum(x, "sp")
+
+        g = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P())
+        """
+    ))
+    findings = lint_paths([pkg], root=tmp_path)
+    assert "collective-axis-unbound" in [f.rule for f in findings]
+    assert findings and findings[0].path.endswith("user.py") or any(
+        f.path.endswith("user.py") for f in findings
+    )
+
+
+def test_helper_kwarg_flagged_only_when_helper_consumes_axis():
+    """A helper forwarding axis_name into its own vmap binds the axis
+    itself — exempt; one feeding it into a collective consumes the
+    caller's scope — checked."""
+    clean = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp",))
+
+        def heads_attn(x, axis_name="heads"):
+            return jax.vmap(do_head, axis_name=axis_name)(x)
+
+        def outer(x):
+            return heads_attn(x, axis_name="heads")
+
+        g = jax.shard_map(outer, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        """
+    )
+    assert "collective-axis-unbound" not in _rules_of(clean)
+    bad = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp",))
+
+        def ring(x, axis_name="sp"):
+            return jax.lax.ppermute(x, axis_name, perm)
+
+        def outer(x):
+            return ring(x, axis_name="sp")
+
+        g = jax.shard_map(outer, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        """
+    )
+    assert "collective-axis-unbound" in _rules_of(bad)
+
+
+def test_pmap_literal_axis_checked():
+    findings = _lint(
+        """
+        import jax
+
+        def f(x):
+            return jax.lax.psum(x, "batch")
+
+        g = jax.pmap(f, axis_name="devices")
+        """
+    )
+    assert "collective-axis-unbound" in _rules_of(findings)
+
+
+def test_vmap_axis_name_inside_shard_map_not_checked_against_mesh():
+    """vmap/xmap bind their own axis_name; neither the kwarg nor the
+    collectives inside the vmapped function answer to the outer mesh."""
+    clean = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp",))
+
+        def outer(x):
+            def g(y):
+                return jax.lax.psum(y, "v")
+            return jax.vmap(g, axis_name="v")(x)
+
+        s = jax.shard_map(outer, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        """
+    )
+    assert "collective-axis-unbound" not in _rules_of(clean)
+
+
+def test_pspec_axis_unbound_flagged_and_clean():
+    findings = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp", "tp"))
+        bad = NamedSharding(mesh, P(None, "model"))
+        """
+    )
+    assert "pspec-axis-unbound" in _rules_of(findings)
+    clean = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp", "tp"))
+        ok = NamedSharding(mesh, P(None, "tp"))
+        """
+    )
+    assert "pspec-axis-unbound" not in _rules_of(clean)
+
+
+def test_pspec_axis_unbound_in_shard_map_specs():
+    findings = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp",))
+        f = jax.shard_map(
+            lambda x: x, mesh=mesh, in_specs=P("sp"), out_specs=P("dp")
+        )
+        """
+    )
+    assert "pspec-axis-unbound" in _rules_of(findings)
+
+
+def test_pallas_blockspec_indivisible_flagged_and_clean():
+    bad = """
+    import jax
+    from jax.experimental import pallas as pl
+
+    def run(x):
+        return pl.pallas_call(
+            kernel,
+            grid=(4,),
+            out_specs=pl.BlockSpec((48,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((100,), x.dtype),
+        )(x)
+    """
+    assert "pallas-blockspec-static" in _rules_of(_lint(bad))
+    clean = bad.replace("(48,)", "(25,)")
+    assert "pallas-blockspec-static" not in _rules_of(_lint(clean))
+
+
+def test_pallas_blockspec_rank_mismatch_flagged():
+    findings = _lint(
+        """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def run(x):
+            return pl.pallas_call(
+                kernel,
+                out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0, 0)),
+                out_shape=jax.ShapeDtypeStruct((2, 64, 128), x.dtype),
+            )(x)
+        """
+    )
+    assert "pallas-blockspec-static" in _rules_of(findings)
+
+
+def test_pallas_blockspec_dynamic_dims_stay_silent():
+    """Non-literal dims (the ops/attention.py idiom) must not be guessed."""
+    clean = _lint(
+        """
+        import jax
+        from jax.experimental import pallas as pl
+
+        def run(x, block_q, T, D):
+            return pl.pallas_call(
+                kernel,
+                out_specs=pl.BlockSpec((1, block_q, D), lambda b, q: (b, q, 0)),
+                out_shape=jax.ShapeDtypeStruct((8, T, D), x.dtype),
+            )(x)
+        """
+    )
+    assert "pallas-blockspec-static" not in _rules_of(clean)
+
+
+def test_donated_buffer_reuse_flagged_and_rebind_ok():
+    findings = _lint(
+        """
+        import jax
+
+        f = jax.jit(step, donate_argnums=(0,))
+
+        def train(state, batch):
+            new_state = f(state, batch)
+            return state.params, new_state
+        """
+    )
+    assert "donated-buffer-reuse" in _rules_of(findings)
+    clean = _lint(
+        """
+        import jax
+
+        f = jax.jit(step, donate_argnums=(0,))
+
+        def train(state, batch):
+            state = f(state, batch)
+            return state.params
+        """
+    )
+    assert "donated-buffer-reuse" not in _rules_of(clean)
+
+
+def test_mesh_rebinding_after_use_does_not_apply_retroactively():
+    """Resolution picks the last assignment AT OR BEFORE the use site: a
+    mesh rebound later in the scope must not change earlier checks."""
+    clean = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        def f(d):
+            mesh = Mesh(d, ("x",))
+            s1 = NamedSharding(mesh, P("x"))
+            mesh = Mesh(d, ("y",))
+            s2 = NamedSharding(mesh, P("y"))
+            return s1, s2
+        """
+    )
+    assert "pspec-axis-unbound" not in _rules_of(clean)
+
+
+def test_decorator_form_nested_pmap_not_checked_against_outer_axes():
+    clean = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("x",))
+
+        def outer(z):
+            @jax.pmap(axis_name="i")
+            def inner(y):
+                return jax.lax.psum(y, "i")
+            return inner(z)
+
+        g = jax.shard_map(outer, mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+        """
+    )
+    assert "collective-axis-unbound" not in _rules_of(clean)
+
+
+def test_last_mesh_assignment_wins():
+    """Name resolution is last-assignment-by-source-position: a rebound
+    mesh must be checked against its final axes, not its first."""
+    clean = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("x",))
+        mesh = Mesh(devs, axis_names=("data", "model"))
+
+        def f(a):
+            return jax.lax.psum(a, "model")
+
+        g = jax.shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P())
+        """
+    )
+    assert "collective-axis-unbound" not in _rules_of(clean)
+
+
+def test_donated_partial_decorator_form_flagged():
+    """@partial(jax.jit, donate_argnums=...) decorated defs donate too."""
+    findings = _lint(
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, batch):
+            return state
+
+        def train(state, batch):
+            new = step(state, batch)
+            return state.params, new
+        """
+    )
+    assert "donated-buffer-reuse" in _rules_of(findings)
+
+
+def test_donated_buffer_reuse_inside_loop_body():
+    """The realistic shape: donate in a training loop, read the stale name
+    on the next line of the same loop body."""
+    findings = _lint(
+        """
+        import jax
+
+        jit_step = jax.jit(step, donate_argnums=(0,))
+
+        def loop(state, batches):
+            for b in batches:
+                new_state = jit_step(state, b)
+                log(state.step)
+                state = new_state
+            return state
+        """
+    )
+    assert "donated-buffer-reuse" in _rules_of(findings)
+
+
+def test_donated_conditional_spec_stays_silent():
+    """`donate_argnums=(0,) if donate else ()` (the learner.py idiom) is
+    not a literal spec — no guessing."""
+    clean = _lint(
+        """
+        import jax
+
+        def make(step, donate):
+            return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+        def train(f, state, batch):
+            out = f(state, batch)
+            return state.params, out
+        """
+    )
+    assert "donated-buffer-reuse" not in _rules_of(clean)
+
+
+def test_nested_transform_not_checked_against_outer_axes():
+    """A nested shard_map binds its own axes: its collectives answer to
+    the inner mesh (checked by the inner scope), never the outer's."""
+    clean = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(devs, axis_names=("dp",))
+        mesh2 = Mesh(devs2, axis_names=("tp",))
+
+        def outer(x):
+            def inner(y):
+                return jax.lax.psum(y, "tp")
+            return jax.shard_map(
+                inner, mesh=mesh2, in_specs=P("tp"), out_specs=P()
+            )(x)
+
+        g = jax.shard_map(outer, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        """
+    )
+    assert "collective-axis-unbound" not in _rules_of(clean)
+    # ... but a wrong axis INSIDE the nested transform is still caught.
+    bad = _lint(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh2 = Mesh(devs2, axis_names=("tp",))
+
+        def outer(x):
+            def inner(y):
+                return jax.lax.psum(y, "sp")
+            return jax.shard_map(
+                inner, mesh=mesh2, in_specs=P("tp"), out_specs=P()
+            )(x)
+        """
+    )
+    assert "collective-axis-unbound" in _rules_of(bad)
+
+
+def test_donated_read_in_try_body_with_handler_store_still_flagged():
+    """A handler's rebind must not mask a stale read in the try BODY
+    (handlers are scanned as exclusive branches, not as a prefix)."""
+    findings = _lint(
+        """
+        import jax
+
+        f = jax.jit(step, donate_argnums=(0,))
+
+        def train(state, b):
+            new = f(state, b)
+            try:
+                use(state.params)
+            except Exception:
+                state = recover()
+            return new
+        """
+    )
+    assert "donated-buffer-reuse" in _rules_of(findings)
+
+
+def test_donated_in_one_branch_sibling_read_ok():
+    clean = _lint(
+        """
+        import jax
+
+        f = jax.jit(step, donate_argnums=(0,))
+
+        def train(state, b, cond):
+            if cond:
+                new = f(state, b)
+                return new
+            else:
+                return state.params
+        """
+    )
+    assert "donated-buffer-reuse" not in _rules_of(clean)
+
+
+# -- rule family: RPC round/counter balance -----------------------------------
+
+
+def test_counter_unbalanced_except_flagged():
+    findings = _lint(
+        """
+        import threading
+
+        class Acc:
+            def start(self):
+                self._round_inflight = True
+                try:
+                    self.dispatch()
+                except RuntimeError:
+                    return  # BUG: gate never restored
+
+            def finish(self):
+                self._round_inflight = False
+        """
+    )
+    assert "counter-unbalanced-except" in _rules_of(findings)
+
+
+def test_counter_restored_in_handler_ok():
+    clean = _lint(
+        """
+        import threading
+
+        class Acc:
+            def start(self):
+                self._round_inflight = True
+                try:
+                    self.dispatch()
+                except RuntimeError:
+                    self._round_inflight = False
+                    return
+
+            def finish(self):
+                self._round_inflight = False
+        """
+    )
+    assert "counter-unbalanced-except" not in _rules_of(clean)
+
+
+def test_counter_restored_via_local_helper_ok():
+    """The settle_locked idiom: a class-local helper that decrements
+    counts as touching the counter (one-level call graph)."""
+    clean = _lint(
+        """
+        import threading
+
+        class Acc:
+            def go(self):
+                self._grads_inflight += 1
+
+                def settle():
+                    self._grads_inflight -= 1
+
+                try:
+                    self.launch()
+                except RuntimeError:
+                    settle()
+                    return
+        """
+    )
+    assert "counter-unbalanced-except" not in _rules_of(clean)
+
+
+def test_counter_guard_with_outer_restore_ok():
+    """The recommended nesting: an inner cancellation guard re-raises into
+    an outer handler that restores on every exception path — raise exits
+    inside a try body must route through the enclosing handlers."""
+    clean = _lint(
+        """
+        import asyncio
+
+        class Acc:
+            def start(self):
+                self._round_inflight = True
+                try:
+                    try:
+                        self.dispatch()
+                    except asyncio.CancelledError:
+                        raise
+                except BaseException:
+                    self._round_inflight = False
+                    raise
+                self._round_inflight = False
+        """
+    )
+    assert "counter-unbalanced-except" not in _rules_of(clean)
+
+
+def test_counter_leak_via_handler_dispatch_caught():
+    """A risky dispatch INSIDE an except handler is not protected by its
+    own try; the elevated-gate path out of the handler is flagged."""
+    findings = _lint(
+        """
+        import threading
+
+        class Group:
+            def update(self):
+                self._ping_inflight = True
+                try:
+                    self.prep()
+                except RuntimeError:
+                    self.rpc.dispatch()
+
+            def pong(self):
+                self._ping_inflight = False
+        """
+    )
+    assert "counter-unbalanced-except" in _rules_of(findings)
+
+
+def test_gate_raised_after_unrelated_try_not_blamed_on_it():
+    """A completed, unrelated try/except earlier in the method must not
+    taint a gate raised afterwards on the normal path."""
+    clean = _lint(
+        """
+        import threading
+
+        class Acc:
+            def update(self):
+                try:
+                    self._expire()
+                except RuntimeError:
+                    pass
+                self._grads_inflight += 1
+                try:
+                    self.dispatch(self._cb)
+                except RuntimeError:
+                    self._grads_inflight -= 1
+
+            def _cb(self):
+                self._grads_inflight -= 1
+        """
+    )
+    assert "counter-unbalanced-except" not in _rules_of(clean)
+
+
+def test_defensive_reset_does_not_oblige_sibling_handlers():
+    """A handler's defensive reset of a counter the function's normal flow
+    never manages must not force the cancellation guard to mirror it."""
+    clean = _lint(
+        """
+        import asyncio
+
+        class Pool:
+            def serve(self, fut):
+                try:
+                    fut.result(timeout=0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    self._busy = False
+
+            def toggle(self):
+                self._busy = True
+        """
+    )
+    assert "counter-restore-parity" not in _rules_of(clean)
+
+
+def test_counter_restored_in_finally_ok():
+    clean = _lint(
+        """
+        import threading
+
+        class Acc:
+            def push(self, payload):
+                self._apply_inflight = True
+                try:
+                    self.apply(payload)
+                finally:
+                    self._apply_inflight = False
+        """
+    )
+    assert "counter-unbalanced-except" not in _rules_of(clean)
+
+
+def test_counter_restore_parity_flagged_and_clean():
+    bad = """
+    import asyncio
+
+    class Acc:
+        def done(self, fut):
+            try:
+                fut.result(timeout=0)
+            except asyncio.CancelledError:
+                raise  # BUG: sibling restores, this path does not
+            except Exception:
+                self._round_inflight = False
+                return
+            self._round_inflight = False
+
+        def start(self):
+            self._round_inflight = True
+    """
+    assert "counter-restore-parity" in _rules_of(_lint(bad))
+    good = bad.replace(
+        "raise  # BUG: sibling restores, this path does not",
+        "self._round_inflight = False\n                raise",
+    )
+    assert "counter-restore-parity" not in _rules_of(_lint(good))
+
+
+def test_counter_parity_satisfied_by_finally():
+    """A finally that restores covers every handler — the
+    guard-plus-finally pattern must not be flagged."""
+    clean = _lint(
+        """
+        import asyncio
+
+        class Acc:
+            def done(self, fut):
+                try:
+                    fut.result(timeout=0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    return
+                finally:
+                    self._round_inflight = False
+
+            def start(self):
+                self._round_inflight = True
+        """
+    )
+    assert "counter-restore-parity" not in _rules_of(clean)
+
+
+def test_inflight_gate_not_silenced_by_unrelated_later_try():
+    """Only a try around the FIRST risky call counts as failure handling;
+    an unrelated try later in the method must not mask the leak."""
+    findings = _lint(
+        """
+        import threading
+
+        class Group:
+            def update(self):
+                self._ping_inflight = True
+                self.rpc.dispatch()
+                try:
+                    self.log_stats()
+                except RuntimeError:
+                    pass
+
+            def pong(self):
+                self._ping_inflight = False
+        """
+    )
+    assert "inflight-gate-unguarded" in _rules_of(findings)
+
+
+def test_nested_callback_try_reported_once_with_right_owner():
+    """A try inside a nested completion callback belongs to the callback's
+    iteration only — no duplicate finding attributed to the method."""
+    findings = _lint(
+        """
+        import asyncio
+
+        class Acc:
+            def start(self):
+                self._round_inflight = True
+
+                def on_done(fut):
+                    try:
+                        fut.result(timeout=0)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        self._round_inflight = False
+                        return
+                    self._round_inflight = False
+                self.launch(on_done)
+        """
+    )
+    parity = [f for f in findings if f.rule == "counter-restore-parity"]
+    assert len(parity) == 1
+    assert "on_done" in parity[0].message
+
+
+def test_inflight_gate_unguarded_after_gate_oblivious_try():
+    """A try that never touches the gate is not failure handling FOR the
+    gate: a later unguarded call must still be flagged (and a try whose
+    handler restores still suppresses)."""
+    findings = _lint(
+        """
+        import threading
+
+        class Group:
+            def update(self):
+                self._ping_inflight = True
+                try:
+                    self.prep()
+                except RuntimeError:
+                    pass
+                self.rpc.dispatch()
+
+            def pong(self):
+                self._ping_inflight = False
+        """
+    )
+    assert "inflight-gate-unguarded" in _rules_of(findings)
+    clean = _lint(
+        """
+        import threading
+
+        class Group:
+            def update(self):
+                self._ping_inflight = True
+                try:
+                    self.rpc.dispatch()
+                except RuntimeError:
+                    self._ping_inflight = False
+                fut.add_done_callback(cb)
+
+            def pong(self):
+                self._ping_inflight = False
+        """
+    )
+    assert "inflight-gate-unguarded" not in _rules_of(clean)
+
+
+def test_inflight_gate_unguarded_flagged_and_clean():
+    bad = """
+    import threading
+
+    class Group:
+        def update(self):
+            self._ping_inflight = True
+            self.rpc.dispatch()
+
+        def pong(self):
+            self._ping_inflight = False
+    """
+    assert "inflight-gate-unguarded" in _rules_of(_lint(bad))
+    good = """
+    import threading
+
+    class Group:
+        def update(self):
+            self._ping_inflight = True
+            try:
+                self.rpc.dispatch()
+            except BaseException:
+                self._ping_inflight = False
+                raise
+
+        def pong(self):
+            self._ping_inflight = False
+    """
+    assert "inflight-gate-unguarded" not in _rules_of(_lint(good))
+
+
 # -- engine: suppressions + baseline ------------------------------------------
 
 
@@ -466,6 +1341,88 @@ def test_lint_scans_under_hidden_ancestor_but_skips_dot_subdirs(tmp_path):
     findings = lint_paths([root], root=tmp_path)
     assert [f.rule for f in findings] == ["async-blocking-call"]
     assert findings[0].path.endswith("m.py")
+
+
+def test_line_suppression_works_for_new_rule_families():
+    src = """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(devs, axis_names=("dp",))
+    s = NamedSharding(mesh, P("tp"))  # moolint: disable=pspec-axis-unbound
+    """
+    assert "pspec-axis-unbound" not in _rules_of(_lint(src))
+    src_wrong = src.replace("disable=pspec-axis-unbound",
+                            "disable=collective-axis-unbound")
+    assert "pspec-axis-unbound" in _rules_of(_lint(src_wrong))
+
+
+def test_baseline_file_roundtrip_identical_findings(tmp_path):
+    """write -> reload -> identical: a saved baseline must grandfather
+    exactly the findings it was built from (no new, no fixed) and survive
+    a byte-level round trip."""
+    src = """
+    import asyncio
+    import time
+
+    async def f():
+        time.sleep(1)
+
+    async def g(fut):
+        fut.result()
+    """
+    findings = _lint(src)
+    assert len(findings) == 2
+    path = tmp_path / "baseline.json"
+    save_baseline(path, findings)
+    reloaded = load_baseline(path)
+    assert reloaded == findings_to_baseline(findings)
+    new, fixed = diff_against_baseline(findings, reloaded)
+    assert new == [] and fixed == []
+    # Saving what load_baseline returned must be byte-identical.
+    path2 = tmp_path / "baseline2.json"
+    path2.write_text(json.dumps(reloaded, indent=1) + "\n")
+    assert path.read_text() == path2.read_text()
+
+
+def test_cli_baseline_stats(tmp_path):
+    """--baseline-stats prints the remaining grandfathered count (the CI
+    burn-down line) and exits 0; works on a synthetic baseline too."""
+    bad = tmp_path / "scratch.py"
+    bad.write_text(
+        "import asyncio\nimport time\n\n"
+        "async def handler():\n    time.sleep(1)\n"
+    )
+    base = tmp_path / "base.json"
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--baseline", str(base),
+         "--baseline-update", str(bad)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--baseline", str(base),
+         "--baseline-stats"],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "1 grandfathered finding(s)" in proc.stdout
+    assert "async-blocking-call" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--baseline", str(base),
+         "--baseline-stats", "--json"],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    data = json.loads(proc.stdout)
+    assert data["total"] == 1
+    assert data["per_rule"] == {"async-blocking-call": 1}
+    # Positional paths are rejected, not silently ignored.
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--baseline-stats", "tools/"],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "takes no paths" in proc.stderr
 
 
 def test_baseline_identity_survives_line_shifts():

@@ -68,8 +68,9 @@ MODULES = [
     ("moolib_tpu.utils.profiling", "XLA profiler capture"),
     ("moolib_tpu.utils.flops", "analytic FLOPs accounting / MFU"),
     ("moolib_tpu.utils.nest", "nested-structure utilities"),
-    ("moolib_tpu.analysis", "moolint: async-RPC safety + JAX trace-hygiene "
-     "static analysis (tier-1 enforced)"),
+    ("moolib_tpu.analysis", "moolint: async-RPC safety, JAX trace hygiene, "
+     "sharding/collective consistency + RPC round-balance static analysis "
+     "(tier-1 enforced)"),
     ("moolib_tpu.broker", "broker CLI (python -m moolib_tpu.broker)"),
 ]
 

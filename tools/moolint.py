@@ -1,11 +1,12 @@
 #!/usr/bin/env python
-"""moolint CLI: project-native static analysis for async-RPC safety and
-JAX trace hygiene.
+"""moolint CLI: project-native static analysis for async-RPC safety, JAX
+trace hygiene, sharding/collective consistency, and RPC round balance.
 
 Usage:
     python tools/moolint.py [paths...]            # lint vs the baseline
     python tools/moolint.py --check moolib_tpu/   # same, explicit
     python tools/moolint.py --baseline-update     # re-grandfather findings
+    python tools/moolint.py --baseline-stats      # burn-down counters
     python tools/moolint.py --list-rules
     python tools/moolint.py --json moolib_tpu/
 
@@ -48,6 +49,10 @@ def main(argv=None) -> int:
                     help="report every finding; ignore the baseline")
     ap.add_argument("--baseline-update", action="store_true",
                     help="write the current findings as the new baseline")
+    ap.add_argument("--baseline-stats", action="store_true",
+                    help="print the grandfathered-finding count (per rule "
+                         "and per file) so the burn-down is visible in CI "
+                         "output, then exit")
     ap.add_argument("--list-rules", action="store_true",
                     help="list registered rules and exit")
     ap.add_argument("--only", action="append", default=None, metavar="RULE",
@@ -68,6 +73,16 @@ def main(argv=None) -> int:
                  for r in all_rules()], indent=1,
             ))
         return 0
+
+    if args.baseline_stats:
+        if args.paths:
+            # Stats come from the baseline FILE, not from linting paths —
+            # silently ignoring paths would let an operator read package
+            # numbers as if they were tree numbers.
+            print("moolint: error: --baseline-stats takes no paths; pick "
+                  "the ledger with --baseline", file=sys.stderr)
+            return 2
+        return baseline_stats(args)
 
     paths = [Path(p) for p in (args.paths or [REPO_ROOT / "moolib_tpu"])]
     only = None
@@ -127,6 +142,42 @@ def main(argv=None) -> int:
                "fixed — shrink with --baseline-update" if fixed else "")
         )
     return 1 if new else 0
+
+
+def baseline_stats(args) -> int:
+    """Burn-down visibility: how much grandfathered debt remains."""
+    if not args.baseline.exists():
+        print(f"moolint: baseline {args.baseline}: absent (0 grandfathered "
+              "findings)")
+        return 0
+    try:
+        baseline = load_baseline(args.baseline)
+    except LintError as e:
+        print(f"moolint: error: {e}", file=sys.stderr)
+        return 2
+    entries = baseline.get("findings", [])
+    total = sum(int(e.get("count", 1)) for e in entries)
+    per_rule: dict = {}
+    per_file: dict = {}
+    for e in entries:
+        n = int(e.get("count", 1))
+        per_rule[e["rule"]] = per_rule.get(e["rule"], 0) + n
+        per_file[e["path"]] = per_file.get(e["path"], 0) + n
+    if args.as_json:
+        print(json.dumps({
+            "baseline": str(args.baseline),
+            "total": total,
+            "per_rule": per_rule,
+            "per_file": per_file,
+        }, indent=1))
+        return 0
+    print(f"moolint: baseline {args.baseline.name}: {total} grandfathered "
+          f"finding(s) across {len(per_file)} file(s)")
+    for rule, n in sorted(per_rule.items(), key=lambda kv: -kv[1]):
+        print(f"  {n:4d}  {rule}")
+    for path, n in sorted(per_file.items(), key=lambda kv: -kv[1]):
+        print(f"  {n:4d}  {path}")
+    return 0
 
 
 if __name__ == "__main__":
