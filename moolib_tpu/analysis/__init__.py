@@ -18,12 +18,22 @@ families:
   otherwise only explode at trace time on a real multi-chip mesh;
 - :mod:`rules_protocol` — round/counter balance (paths through exception
   edges that leave ``_round_inflight``-style gates elevated — the bug
-  shape PR 1 fixed by hand in ``rpc/group.py``).
+  shape PR 1 fixed by hand in ``rpc/group.py``);
+- :mod:`rules_wire` — RPC wire-surface consistency (calls to endpoints no
+  module defines, payload/handler arity skew, duplicate registrations,
+  provably unserializable payloads, bare ``.result()`` on RPC-origin
+  futures — the bug classes a stringly-typed RPC surface only reveals at
+  runtime on a live cohort).
 
 The sharding and protocol families lean on a small interprocedural layer
 in :mod:`engine` (per-module symbol tables + a project index, one import
 hop deep) so axis names flowing through ``parallel/mesh.py`` helpers and
-counter writes through class-local helpers resolve.
+counter writes through class-local helpers resolve. The wire family adds
+a project-wide endpoint registry on that index: ``define`` names —
+including f-string patterns like ``f"{name}::step"``, abstracted to
+wildcard patterns — are matched against every call site by pattern
+overlap, and handler signatures resolve through methods, lambdas, local
+defs, and one import hop.
 
 Entry points:
 

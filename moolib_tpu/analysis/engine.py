@@ -20,6 +20,14 @@ Design (mirrors how large projects keep a lint suite adoptable):
   ``parallel/mesh.py`` while checking ``learner.py``). Resolution is
   strictly best-effort: anything the index cannot see resolves to None
   and the rule must stay silent rather than guess.
+- Wire-surface layer: the index also builds a project-wide **endpoint
+  registry** from every ``define``/``define_queue``/``define_deferred``
+  call (:meth:`ProjectIndex.endpoints`). Endpoint names are abstracted to
+  wildcard patterns (:func:`name_pattern`) so f-string registrations like
+  ``f"{name}::step"`` resolve against literal and f-string call sites by
+  pattern overlap (:func:`patterns_overlap`); handler signatures resolve
+  through module functions, local defs, ``self.<method>`` references,
+  lambdas, and the decorator form, feeding the ``rules_wire`` family.
 """
 
 from __future__ import annotations
@@ -34,11 +42,14 @@ from pathlib import Path
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 __all__ = [
+    "EndpointDef",
+    "EndpointSig",
     "Finding",
     "LintError",
     "ModuleContext",
     "ProjectIndex",
     "Rule",
+    "WILDCARD",
     "all_rules",
     "diff_against_baseline",
     "findings_to_baseline",
@@ -47,6 +58,11 @@ __all__ = [
     "lint_paths",
     "lint_source",
     "load_baseline",
+    "name_pattern",
+    "pattern_display",
+    "patterns_overlap",
+    "receiver_name",
+    "returned_calls",
     "save_baseline",
     "terminal_name",
 ]
@@ -96,6 +112,252 @@ def terminal_name(node: Optional[ast.expr]) -> Optional[str]:
     if isinstance(node, ast.Attribute):
         return node.attr
     return None
+
+
+# -- endpoint-name abstraction ------------------------------------------------
+
+#: Wildcard sentinel inside an abstracted endpoint-name pattern. NUL can
+#: never appear in a real endpoint string, so patterns stay plain strings.
+WILDCARD = "\0"
+
+
+def name_pattern(node: Optional[ast.expr]) -> Optional[str]:
+    """Abstract an endpoint-name expression to a wildcard pattern.
+
+    A string literal is itself; an f-string keeps its literal fragments
+    with each ``{...}`` hole collapsed to :data:`WILDCARD` (so
+    ``f"{name}::step"`` becomes ``\\0::step``). Anything else (a variable,
+    a ``+`` concat, ``str.format``) returns None — unresolvable names must
+    silence wire rules, never make them guess."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                parts.append(WILDCARD)
+            else:
+                return None
+        # Collapse adjacent wildcards: "** " and "*" match the same set.
+        out = "".join(parts)
+        while WILDCARD * 2 in out:
+            out = out.replace(WILDCARD * 2, WILDCARD)
+        return out
+    return None
+
+
+def pattern_display(pattern: str) -> str:
+    """Human-readable form of a wildcard pattern (``{*}`` per hole)."""
+    return pattern.replace(WILDCARD, "{*}")
+
+
+def patterns_overlap(a: str, b: str) -> bool:
+    """Can any concrete endpoint name match BOTH wildcard patterns?
+
+    Classic two-glob intersection nonemptiness, where each wildcard
+    matches any (possibly empty) string. Endpoint names are short, so the
+    memoized (i, j) recursion is plenty."""
+    seen: Dict[Tuple[int, int], bool] = {}
+
+    def go(i: int, j: int) -> bool:
+        key = (i, j)
+        if key in seen:
+            return seen[key]
+        seen[key] = False  # cycle guard (two facing wildcards)
+        if i == len(a) and j == len(b):
+            out = True
+        elif i < len(a) and a[i] == WILDCARD:
+            out = go(i + 1, j) or (j < len(b) and go(i, j + 1))
+        elif j < len(b) and b[j] == WILDCARD:
+            out = go(i, j + 1) or (i < len(a) and go(i + 1, j))
+        elif i < len(a) and j < len(b) and a[i] == b[j]:
+            out = go(i + 1, j + 1)
+        else:
+            out = False
+        seen[key] = out
+        return out
+
+    return go(0, 0)
+
+
+#: The registration surface of the RPC layer (``rpc/rpc.py``).
+ENDPOINT_DEFINERS = ("define", "define_queue", "define_deferred")
+
+
+@dataclasses.dataclass
+class EndpointDef:
+    """One ``define``/``define_queue``/``define_deferred`` registration."""
+
+    pattern: str              # wildcard name pattern
+    kind: str                 # one of ENDPOINT_DEFINERS
+    ctx: "ModuleContext"      # module the registration lives in
+    node: ast.Call            # the define call
+    handler: Optional[ast.AST] = None  # FunctionDef/AsyncFunctionDef/Lambda
+    handler_is_method: bool = False    # drop the leading ``self`` param
+
+    def display(self) -> str:
+        return pattern_display(self.pattern)
+
+    def signature(self) -> Optional["EndpointSig"]:
+        """The handler's PAYLOAD signature (``self`` and the deferred
+        handle dropped), or None when unknown / a queue endpoint (queues
+        accept anything — arity is the consumer's business)."""
+        if self.handler is None or self.kind == "define_queue":
+            return None
+        a = self.handler.args
+        params = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        drop = (1 if self.handler_is_method else 0) + (
+            1 if self.kind == "define_deferred" else 0
+        )
+        if len(params) < drop:
+            return None  # malformed handler; don't guess
+        params = params[drop:]
+        kw_defaulted = {
+            p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults)
+            if d is not None
+        }
+        return EndpointSig(
+            params=params,
+            n_defaults=len(a.defaults),
+            has_vararg=a.vararg is not None,
+            has_kwarg=a.kwarg is not None,
+            kwonly=[p.arg for p in a.kwonlyargs],
+            kwonly_required=[
+                p.arg for p in a.kwonlyargs if p.arg not in kw_defaulted
+            ],
+        )
+
+
+@dataclasses.dataclass
+class EndpointSig:
+    """Payload-facing handler signature (see :meth:`EndpointDef.signature`)."""
+
+    params: List[str]
+    n_defaults: int
+    has_vararg: bool
+    has_kwarg: bool
+    kwonly: List[str]
+    kwonly_required: List[str]
+
+
+def receiver_name(node: Optional[ast.expr]) -> Optional[str]:
+    """Dotted receiver of an attribute access (``self.rpc`` for
+    ``self.rpc.define(...)``'s func.value); None when any link is not a
+    plain Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def returned_calls(fn: ast.AST) -> List[ast.Call]:
+    """Call expressions ``fn`` can return directly (scoped walk — nested
+    defs excluded). The one-hop leg of Future-origin dataflow: a function
+    whose returns are all RPC calls produces RPC futures."""
+    if isinstance(fn, ast.Lambda):
+        return [fn.body] if isinstance(fn.body, ast.Call) else []
+    out: List[ast.Call] = []
+    for node in iter_scoped_body(getattr(fn, "body", [])):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Call):
+            out.append(node.value)
+    return out
+
+
+def _local_defs(body: Iterable[ast.stmt]) -> Dict[str, ast.AST]:
+    return {
+        n.name: n for n in body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _module_endpoints(ctx: "ModuleContext") -> List["EndpointDef"]:
+    """Every endpoint registration in one module, with handlers resolved
+    through local defs, ``self.<method>`` references, lambdas, the
+    decorator form, and (via the project) one from-import hop."""
+    out: List[EndpointDef] = []
+    # Decorator-form registrations: ``@rpc.define("name")`` above a def
+    # binds THAT def as the handler (the define call sees no fn arg).
+    decorated: Dict[int, Tuple[ast.AST, bool]] = {}
+
+    def handle_call(call: ast.Call, cls: Optional[ast.ClassDef],
+                    scopes: List[Dict[str, ast.AST]]):
+        kind = terminal_name(call.func)
+        if kind not in ENDPOINT_DEFINERS \
+                or not isinstance(call.func, ast.Attribute):
+            return  # a bare define() is not a registration on an Rpc
+        if not call.args:
+            return
+        pattern = name_pattern(call.args[0])
+        if pattern is None:
+            return  # unresolvable name: the registration stays invisible
+        handler: Optional[ast.AST] = None
+        is_method = False
+        if id(call) in decorated:
+            handler, is_method = decorated[id(call)]
+        elif kind != "define_queue" and len(call.args) >= 2:
+            h = call.args[1]
+            if isinstance(h, ast.Lambda):
+                handler = h
+            elif (isinstance(h, ast.Attribute)
+                    and isinstance(h.value, ast.Name)
+                    and h.value.id == "self" and cls is not None):
+                for n in cls.body:
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                            and n.name == h.attr:
+                        handler, is_method = n, True
+                        break
+            elif isinstance(h, ast.Name):
+                for sc in reversed(scopes):
+                    if h.id in sc:
+                        handler = sc[h.id]
+                        break
+                else:
+                    resolved = ctx.project.resolve_function(ctx, h.id)
+                    if resolved is not None:
+                        handler = resolved[1]
+        out.append(EndpointDef(pattern=pattern, kind=kind, ctx=ctx,
+                               node=call, handler=handler,
+                               handler_is_method=is_method))
+
+    def visit(node: ast.AST, cls: Optional[ast.ClassDef],
+              scopes: List[Dict[str, ast.AST]]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                for sub in ast.walk(dec):
+                    if isinstance(sub, ast.Call) and len(sub.args) == 1 \
+                            and terminal_name(sub.func) in ENDPOINT_DEFINERS:
+                        decorated[id(sub)] = (node, cls is not None)
+                visit_expr(dec, cls, scopes)
+            inner = scopes + [_local_defs(node.body)]
+            for child in node.body:
+                visit(child, cls, inner)
+            return
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                visit(child, node, scopes)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Call):
+            handle_call(node, cls, scopes)
+        for child in ast.iter_child_nodes(node):
+            visit(child, cls, scopes)
+
+    def visit_expr(node, cls, scopes):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                handle_call(sub, cls, scopes)
+
+    top = [_local_defs(ctx.tree.body)]
+    for stmt in ctx.tree.body:
+        visit(stmt, None, top)
+    return out
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -315,13 +577,30 @@ class ProjectIndex:
 
     def __init__(self, contexts: Sequence[ModuleContext] = ()):
         self.by_name: Dict[str, ModuleContext] = {}
+        self.contexts: List[ModuleContext] = []
+        self._endpoints: Optional[List[EndpointDef]] = None
         for ctx in contexts:
             self.add(ctx)
 
     def add(self, ctx: ModuleContext):
         if ctx.module_name is not None:
             self.by_name[ctx.module_name] = ctx
+        self.contexts.append(ctx)
         ctx.project = self
+        self._endpoints = None  # registry is rebuilt after membership changes
+
+    def endpoints(self) -> List["EndpointDef"]:
+        """The project-wide endpoint registry: every ``define`` /
+        ``define_queue`` / ``define_deferred`` registration across all
+        linted modules (including ones whose path doesn't map to a dotted
+        module name — scratch files still register). Built lazily, once
+        per lint run."""
+        if self._endpoints is None:
+            eps: List[EndpointDef] = []
+            for ctx in self.contexts:
+                eps.extend(_module_endpoints(ctx))
+            self._endpoints = eps
+        return self._endpoints
 
     def module(self, dotted: Optional[str]) -> Optional[ModuleContext]:
         return self.by_name.get(dotted) if dotted else None
@@ -351,13 +630,16 @@ class ProjectIndex:
 
 def all_rules() -> List[Rule]:
     """The full registered rule set (async-safety + JAX trace hygiene +
-    sharding/collective consistency + RPC round/counter balance)."""
-    from . import rules_async, rules_jax, rules_protocol, rules_sharding
+    sharding/collective consistency + RPC round/counter balance + RPC
+    wire-surface consistency)."""
+    from . import (rules_async, rules_jax, rules_protocol, rules_sharding,
+                   rules_wire)
 
     return [
         cls()
         for cls in (rules_async.RULES + rules_jax.RULES
-                    + rules_sharding.RULES + rules_protocol.RULES)
+                    + rules_sharding.RULES + rules_protocol.RULES
+                    + rules_wire.RULES)
     ]
 
 

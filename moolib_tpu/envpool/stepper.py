@@ -65,6 +65,14 @@ class EnvPoolServer:
 
     def __init__(self, rpc, pool, name: str = "envpool",
                  lease_timeout: float = 60.0):
+        if rpc.defined(f"{name}::info"):
+            # Refuse BEFORE registering anything: a second server under
+            # the same name would silently replace the first one's
+            # handlers (same fid) and steal its clients mid-step.
+            raise RuntimeError(
+                f"an EnvPoolServer named {name!r} is already registered "
+                "on this Rpc; pass a distinct name="
+            )
         self.rpc = rpc
         self.pool = pool
         self.name = name

@@ -258,7 +258,9 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
     try:
         while env_steps < cfg.total_steps:
             for i in range(cfg.num_batches):
-                out = futures[i].result()
+                # Bounded wait: a dead env worker must surface as an
+                # error, not hang the training loop forever.
+                out = futures[i].result(timeout=300.0)
                 bs = batch_states[i]
                 unroll = bs.observe(out)
                 if unroll is not None:

@@ -250,7 +250,9 @@ def run_actor(cfg: RemoteConfig, learner_addr: str,
         while deadline is None or time.monotonic() < deadline:
             try:
                 for i in range(2):
-                    out = futures[i].result()
+                    # Bounded wait: a dead env worker must surface as an
+                    # error here, not hang the actor forever.
+                    out = futures[i].result(timeout=300.0)
                     unroll = bs[i].observe(out)
                     if unroll is not None:
                         # Ship the completed unroll; keep at most one in

@@ -385,7 +385,9 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
         ):
             # -- acting (double-buffered) -----------------------------------
             for i in range(cfg.num_actor_batches):
-                out = futures[i].result()
+                # Bounded wait: a dead env worker must surface as an
+                # error, not hang the acting loop forever.
+                out = futures[i].result(timeout=300.0)
                 bs = batch_states[i]
                 unroll = bs.observe(out)
                 if unroll is not None:

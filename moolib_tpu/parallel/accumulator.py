@@ -220,6 +220,13 @@ class Accumulator:
         # constructor call that raises.
         if virtual_batch_size < 1:
             raise ValueError("virtual_batch_size must be >= 1")
+        if rpc.defined("AccumulatorService::requestState"):
+            # Same-fid clobbering: a second Accumulator on one Rpc would
+            # silently replace the first one's state handlers.
+            raise RuntimeError(
+                "an Accumulator is already registered on this Rpc; "
+                "one Rpc peer hosts at most one Accumulator"
+            )
         self.rpc = rpc
         self.group = group or Group(
             rpc, broker_name=broker_name, group_name=group_name, timeout=timeout

@@ -283,7 +283,10 @@ class Group:
         if cancelled:
             pool = _completion_executor()
             for op in cancelled:
-                pool.submit(
+                # Fire-and-forget by design: _set_exception only completes
+                # the op future (never raises), so the worker future is
+                # empty by construction.
+                pool.submit(  # moolint: disable=dropped-future
                     op.future._set_exception,
                     RpcError(
                         f"allreduce {op.key} cancelled: membership changed"
@@ -310,7 +313,8 @@ class Group:
         if expired:
             pool = _completion_executor()
             for op in expired:
-                pool.submit(
+                # Fire-and-forget by design: _set_exception never raises.
+                pool.submit(  # moolint: disable=dropped-future
                     op.future._set_exception,
                     RpcError(f"allreduce {op.key} timed out"),
                 )
@@ -446,6 +450,10 @@ class Group:
                     # parent's completion (which runs user done-callbacks
                     # inline) hops to the completion pool so a blocking
                     # user callback can never occupy a merge thread.
+                    # The four submits below are fire-and-forget by
+                    # design: _set_exception/_set_result never raise, and
+                    # finish() reports every outcome through the parent
+                    # future itself.
                     def finish():
                         try:
                             result = reassemble()
@@ -453,19 +461,19 @@ class Group:
                                 concurrent.futures.CancelledError) as e:
                             # Merge-pool cancellation: fail the parent so
                             # waiters wake, and re-raise.
-                            _completion_executor().submit(
+                            _completion_executor().submit(  # moolint: disable=dropped-future
                                 parent._set_exception, e
                             )
                             raise
                         except Exception as e:  # defensive: shape mismatch
-                            _completion_executor().submit(
+                            _completion_executor().submit(  # moolint: disable=dropped-future
                                 parent._set_exception, e
                             )
                             return
-                        _completion_executor().submit(
+                        _completion_executor().submit(  # moolint: disable=dropped-future
                             parent._set_result, result
                         )
-                    reassembler.submit(finish)
+                    reassembler.submit(finish)  # moolint: disable=dropped-future
             return cb
 
         subs = []
@@ -497,8 +505,11 @@ class Group:
             # thread — and must not share a pool with user done-callbacks
             # that may block on collectives (see _merge_executor). Per-op
             # merge ordering is guaranteed by op.lock in _merge_and_forward,
-            # NOT by pool width.
-            _merge_executor().submit(self._merge_and_forward, op, payload)
+            # NOT by pool width. Fire-and-forget by design: a failed custom
+            # merge surfaces as the op's timeout, exactly like a lost hop.
+            _merge_executor().submit(  # moolint: disable=dropped-future
+                self._merge_and_forward, op, payload
+            )
             return
         self._merge_and_forward(op, payload)
 
@@ -562,7 +573,10 @@ class Group:
         # Service handlers run inline on the RPC IO thread; user
         # done-callbacks (e.g. Accumulator gradient commits) must not — a
         # blocked callback would stall every connection on this Rpc.
-        _completion_executor().submit(op.future._set_result, result)
+        # Fire-and-forget by design: _set_result never raises.
+        _completion_executor().submit(  # moolint: disable=dropped-future
+            op.future._set_result, result
+        )
 
     def close(self):
         shared = getattr(self.rpc, "_moolib_group_shared", None)

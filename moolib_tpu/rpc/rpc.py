@@ -360,7 +360,9 @@ class _Conn:
         if self.sock is not None:
             try:
                 self.sock.close()
-            except Exception:
+            # Sync transport teardown: no await point can deliver a task
+            # cancellation here, and close() failures are moot.
+            except Exception:  # moolint: disable=swallow-cancelled
                 pass
 
 
@@ -465,7 +467,9 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                             memoryview(body)
                         )
                         self._rpc._dispatch(conn, rid, fid, obj)
-                    except Exception as e:
+                    # Sync protocol callback (no awaits): a decode/dispatch
+                    # error must drop the conn, never escape into the loop.
+                    except Exception as e:  # moolint: disable=swallow-cancelled
                         log.error(
                             "frame dispatch error on %s: %s",
                             conn.peer_name, e,
@@ -529,7 +533,8 @@ def _cleanup_live_rpcs():
     for rpc in list(_live_rpcs):
         try:
             rpc.close()
-        except Exception:
+        # atexit teardown: nothing to cancel, nothing to report to.
+        except Exception:  # moolint: disable=swallow-cancelled
             pass
 
 
@@ -597,7 +602,9 @@ class Rpc:
             task.cancel()
         try:
             self._loop.run_until_complete(asyncio.sleep(0))
-        except Exception:
+        # Shutdown drain on a stopping loop: cancellations of the drained
+        # tasks are the POINT here, not a signal to propagate.
+        except Exception:  # moolint: disable=swallow-cancelled
             pass
         self._loop.close()
 
@@ -816,6 +823,9 @@ class Rpc:
             if out.conn is dead and not out.future.done():
                 try:
                     await self._route_and_send(out)
+                except (asyncio.CancelledError,
+                        concurrent.futures.CancelledError):
+                    raise  # task cancellation propagates
                 except Exception:
                     pass  # timeout loop will expire it
 
@@ -917,6 +927,9 @@ class Rpc:
             if out.peer_name == peer.name and out.conn is None:
                 try:
                     await self._route_and_send(out)
+                except (asyncio.CancelledError,
+                        concurrent.futures.CancelledError):
+                    raise  # task cancellation propagates
                 except Exception:
                     pass
 
@@ -1115,12 +1128,26 @@ class Rpc:
             def run():
                 try:
                     respond(fn(*args, **kwargs), None)
+                except (asyncio.CancelledError,
+                        concurrent.futures.CancelledError) as e:
+                    # Tell the caller the call died. On the executor path
+                    # PROPAGATE the cancellation; an inline handler runs
+                    # synchronously inside the frame protocol's dispatch,
+                    # where a re-raise would hit its catch-all and drop
+                    # the whole connection (killing every other in-flight
+                    # call) — the error response is the propagation there.
+                    respond(None, f"{type(e).__name__}: call cancelled")
+                    if not inline:
+                        raise
                 except Exception as e:
                     respond(None, f"{type(e).__name__}: {e}")
             if inline:
                 run()
             else:
-                self._executor.submit(run)
+                # Fire-and-forget by design: every outcome of run() —
+                # including the cancellation re-raise above — reaches the
+                # caller through respond(); the worker future is empty.
+                self._executor.submit(run)  # moolint: disable=dropped-future
 
         self._functions[fid_for(name)] = (name, handler)
         return fn
@@ -1135,10 +1162,18 @@ class Rpc:
             def run():
                 try:
                     fn(dr, *args, **kwargs)
+                except (asyncio.CancelledError,
+                        concurrent.futures.CancelledError) as e:
+                    # Report, then propagate — never swallow cancellation.
+                    if not dr._done:
+                        dr.error(f"{type(e).__name__}: call cancelled")
+                    raise
                 except Exception as e:
                     if not dr._done:
                         dr.error(f"{type(e).__name__}: {e}")
-            self._executor.submit(run)
+            # Fire-and-forget by design: outcomes flow through the
+            # deferred-return handle, not the worker future.
+            self._executor.submit(run)  # moolint: disable=dropped-future
 
         self._functions[fid_for(name)] = (name, handler)
 
@@ -1159,6 +1194,14 @@ class Rpc:
 
         self._functions[fid_for(name)] = (name, handler)
         return queue
+
+    def defined(self, name: str) -> bool:
+        """Whether ``name`` currently has a registered handler — the
+        runtime mirror of moolint's ``rpc-define-collision``: a second
+        ``define`` under the same name silently replaces the first (both
+        hash to one fid), so services registering a family of endpoints
+        should refuse a name that is already taken."""
+        return fid_for(name) in self._functions
 
     def undefine(self, name: str):
         self._functions.pop(fid_for(name), None)
@@ -1213,7 +1256,13 @@ class Rpc:
         return fut
 
     def sync(self, peer: str, func: str, *args, **kwargs):
-        return self.async_(peer, func, *args, **kwargs).result()
+        # The deadline wheel guarantees completion within self._timeout
+        # (captured at dispatch), so the margin only matters when the IO
+        # loop itself is wedged — then a TimeoutError beats hanging the
+        # caller forever with no error path.
+        return self.async_(peer, func, *args, **kwargs).result(
+            self._timeout + 30.0
+        )
 
     async def _write_quiet(self, conn: _Conn, frames: List[Any]):
         """Awaitable write that swallows connection failures — for replies
@@ -1221,12 +1270,18 @@ class Rpc:
         replay), where a raised-but-unconsumed task exception is noise."""
         try:
             await self._write(conn, frames)
+        except (asyncio.CancelledError,
+                concurrent.futures.CancelledError):
+            raise  # only write FAILURES are quiet, not cancellation
         except Exception:
             pass
 
     async def _send_out(self, out: _Outgoing):
         try:
             await self._route_and_send(out)
+        except (asyncio.CancelledError,
+                concurrent.futures.CancelledError):
+            raise  # task cancellation propagates
         except Exception:
             pass  # stays queued; flushed on connect or expired by timeout
 
@@ -1263,6 +1318,9 @@ class Rpc:
                 if conn is not None:
                     try:
                         await self._write(conn, frames)
+                    except (asyncio.CancelledError,
+                            concurrent.futures.CancelledError):
+                        raise  # task cancellation propagates
                     except Exception:
                         pass
         finally:
@@ -1350,6 +1408,9 @@ class Rpc:
                                             out.rid, FID_POKE, None
                                         ),
                                     )
+                                except (asyncio.CancelledError,
+                                        concurrent.futures.CancelledError):
+                                    raise
                                 except Exception:
                                     pass
                     self._sched_out(
@@ -1379,12 +1440,18 @@ class Rpc:
                                 await self._write(
                                     conn, serial.serialize(0, FID_KEEPALIVE, None)
                                 )
+                            except (asyncio.CancelledError,
+                                    concurrent.futures.CancelledError):
+                                raise
                             except Exception:
                                 pass
                 # Anonymous conns that never complete a greeting are GC'd.
                 for conn in list(self._anon_conns):
                     if now - conn.last_recv > max(4.0 * ka, 10.0):
                         self._drop_conn(conn, "no greeting")
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # loop shutdown: let the task die cancelled
             except Exception as e:
                 log.error("timeout loop error: %s", e)
             await asyncio.sleep(0.1)
@@ -1492,6 +1559,11 @@ def _batched_server_loop(queue: Queue, fn: Callable, device,
             if pad_to is not None and n < pad_to:
                 result = nest.slice_fields(result, 0, n)
             return_cb(result)
+        except (asyncio.CancelledError,
+                concurrent.futures.CancelledError) as e:
+            # Fail the whole batch to its callers, then propagate.
+            return_cb.error(f"{type(e).__name__}: batch cancelled")
+            raise
         except Exception as e:
             log.error("batched handler %s failed: %s", queue.name, e)
             return_cb.error(f"{type(e).__name__}: {e}")

@@ -436,3 +436,7 @@ def test_get_leader_and_set_virtual_batch_size(cluster):
         accs[0].set_virtual_batch_size(0)
     with pytest.raises(ValueError):
         Accumulator(cluster.clients[0][0], virtual_batch_size=0)
+    # One Accumulator per Rpc: a second registration would silently
+    # clobber the first one's AccumulatorService handlers (same fid).
+    with pytest.raises(RuntimeError, match="already registered"):
+        Accumulator(cluster.clients[0][0])

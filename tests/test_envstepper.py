@@ -33,6 +33,18 @@ def _client(addr, name):
     return rpc, RemoteEnvStepper(rpc, "env-server")
 
 
+def test_duplicate_server_name_refused(served_pool):
+    """Registering a second EnvPoolServer under a taken name must raise
+    up front — the runtime mirror of moolint's rpc-define-collision (a
+    silent second define would steal the first server's clients)."""
+    server, _addr = served_pool
+    with pytest.raises(RuntimeError, match="already registered"):
+        EnvPoolServer(server.rpc, server.pool)
+    # A distinct name coexists fine.
+    other = EnvPoolServer(server.rpc, server.pool, name="envpool2")
+    other.close()
+
+
 def test_two_clients_step_one_pool_concurrently(served_pool):
     _server, addr = served_pool
     rpc_a, a = _client(addr, "actor-a")
@@ -150,7 +162,9 @@ def test_more_clients_than_executor_threads_all_progress():
         ]
         _time.sleep(0.05)  # steps are now in flight
         t0 = _time.monotonic()
-        info = clients[0][0].async_(
+        # "envpool::info" is registered by EnvPoolServer in the package
+        # tree, which is outside the tools/tests lint run.
+        info = clients[0][0].async_(  # moolint: disable=rpc-endpoint-unknown
             "env-server", "envpool::info"
         ).result(timeout=5)
         control_latency = _time.monotonic() - t0

@@ -1277,6 +1277,413 @@ def test_inflight_gate_unguarded_flagged_and_clean():
 # -- engine: suppressions + baseline ------------------------------------------
 
 
+# -- rule family: RPC wire-surface consistency --------------------------------
+
+
+def test_rpc_endpoint_unknown_flagged_and_clean():
+    findings = _lint(
+        """
+        def setup(rpc):
+            rpc.define("svc::step", lambda x: x)
+            rpc.async_("peer", "svc::stepp", 1)
+        """
+    )
+    assert "rpc-endpoint-unknown" in _rules_of(findings)
+    clean = _lint(
+        """
+        def setup(rpc):
+            rpc.define("svc::step", lambda x: x)
+            rpc.async_("peer", "svc::step", 1)
+        """
+    )
+    assert "rpc-endpoint-unknown" not in _rules_of(clean)
+
+
+def test_rpc_endpoint_unknown_silent_without_registry():
+    """A lint run that sees no registrations at all has a partial view of
+    the wire surface and must not guess."""
+    clean = _lint(
+        """
+        def go(rpc):
+            rpc.async_("peer", "anything::at_all", 1)
+        """
+    )
+    assert "rpc-endpoint-unknown" not in _rules_of(clean)
+
+
+def test_rpc_endpoint_unknown_variable_name_stays_silent():
+    clean = _lint(
+        """
+        def go(rpc, fname):
+            rpc.define("svc::step", lambda x: x)
+            rpc.async_("peer", fname, 1)
+        """
+    )
+    assert "rpc-endpoint-unknown" not in _rules_of(clean)
+
+
+def test_rpc_endpoint_arity_flagged_and_clean():
+    findings = _lint(
+        """
+        def handler(a, b, c=1):
+            return a + b + c
+
+        def go(rpc):
+            rpc.define("svc::add", handler)
+            rpc.sync("peer", "svc::add", 1, 2, 3, 4)   # too many
+            rpc.sync("peer", "svc::add", 1)            # b missing
+            rpc.sync("peer", "svc::add", 1, 2, d=4)    # unknown kwarg
+        """
+    )
+    assert _rules_of(findings).count("rpc-endpoint-arity") == 3
+    clean = _lint(
+        """
+        def handler(a, b, c=1):
+            return a + b + c
+
+        def go(rpc):
+            rpc.define("svc::add", handler)
+            rpc.sync("peer", "svc::add", 1, 2)
+            rpc.sync("peer", "svc::add", 1, b=2, c=3)
+            rpc.async_callback("peer", "svc::add", print, 1, 2)
+        """
+    )
+    assert "rpc-endpoint-arity" not in _rules_of(clean)
+
+
+def test_rpc_endpoint_arity_deferred_and_method_params_dropped():
+    """A define_deferred handler's handle param (and a method's self)
+    are not payload; batch handlers keep per-call arity."""
+    clean = _lint(
+        """
+        class Server:
+            def __init__(self, rpc):
+                rpc.define_deferred("svc::step", self._step)
+                rpc.define("svc::infer", self._infer, batch_size=8)
+
+            def _step(self, deferred, idx, action):
+                deferred(action)
+
+            def _infer(self, obs):
+                return obs
+
+        def go(rpc):
+            rpc.async_("peer", "svc::step", 0, [1, 2])
+            rpc.async_("peer", "svc::infer", [1, 2])
+        """
+    )
+    assert "rpc-endpoint-arity" not in _rules_of(clean)
+    findings = _lint(
+        """
+        class Server:
+            def __init__(self, rpc):
+                rpc.define_deferred("svc::step", self._step)
+
+            def _step(self, deferred, idx, action):
+                deferred(action)
+
+        def go(rpc):
+            rpc.async_("peer", "svc::step", 0, [1, 2], "extra")
+        """
+    )
+    assert "rpc-endpoint-arity" in _rules_of(findings)
+
+
+def test_rpc_endpoint_queue_and_ambiguous_match_exempt_from_arity():
+    clean = _lint(
+        """
+        def go(rpc):
+            rpc.define_queue("unroll")
+            rpc.async_("peer", "unroll", 1, 2, 3, 4, 5)  # queues take anything
+
+            rpc.define(f"{rpc.a}::x", lambda p: p)
+            rpc.define(f"{rpc.b}::x", lambda p, q: p)
+            rpc.async_("peer", "svc::x", 1, 2, 3)  # ambiguous: two matches
+        """
+    )
+    assert "rpc-endpoint-arity" not in _rules_of(clean)
+
+
+def test_rpc_define_collision_flagged_and_clean():
+    findings = _lint(
+        """
+        def setup(rpc):
+            rpc.define("svc::step", lambda x: x)
+            rpc.define("svc::step", lambda x: x + 1)
+        """
+    )
+    assert "rpc-define-collision" in _rules_of(findings)
+    clean = _lint(
+        """
+        def setup(rpc):
+            rpc.define("svc::a", lambda x: x)
+            rpc.define("svc::b", lambda x: x)
+
+        def setup_other(rpc):
+            # Same name in a DIFFERENT registration scope (another Rpc).
+            rpc.define("svc::a", lambda x: x)
+
+        class S:
+            def __init__(self, rpc, name):
+                # Wildcard patterns never collide provably.
+                rpc.define(f"{name}::info", lambda: {})
+        """
+    )
+    assert "rpc-define-collision" not in _rules_of(clean)
+
+
+def test_rpc_define_collision_branch_exclusive_arms_exempt():
+    """if/else arms (and try-body vs handler) are mutually exclusive —
+    selecting a handler implementation by config flag is not a collision;
+    a duplicate WITHIN one arm still is."""
+    clean = _lint(
+        """
+        def setup(rpc, fast):
+            if fast:
+                rpc.define("svc::step", lambda x: x)
+            else:
+                rpc.define("svc::step", lambda x: x + 1)
+            try:
+                rpc.define("svc::aux", lambda: 1)
+            except Exception:
+                rpc.define("svc::aux", lambda: 2)
+        """
+    )
+    assert "rpc-define-collision" not in _rules_of(clean)
+    findings = _lint(
+        """
+        def setup(rpc, fast):
+            if fast:
+                rpc.define("svc::step", lambda x: x)
+                rpc.define("svc::step", lambda x: x + 1)
+        """
+    )
+    assert "rpc-define-collision" in _rules_of(findings)
+    # An unconditional define followed by a conditional redefine is on
+    # one execution path (prefix) and still collides.
+    findings = _lint(
+        """
+        def setup(rpc, fast):
+            rpc.define("svc::step", lambda x: x)
+            if fast:
+                rpc.define("svc::step", lambda x: x + 1)
+        """
+    )
+    assert "rpc-define-collision" in _rules_of(findings)
+
+
+def test_rpc_result_flow_deep_loop_nesting_stays_linear():
+    """The loop back-edge replay must not nest (2^depth scans): 25 nested
+    loops with an RPC flow inside lint in well under a second."""
+    import time as _time
+
+    depth = 25
+    lines = ["def go(rpc):", "    rpc.define_queue('u')"]
+    for i in range(depth):
+        lines.append("    " * (i + 1) + "while True:")
+    pad = "    " * (depth + 1)
+    lines.append(pad + "fut = rpc.async_('p', 'u', 1)")
+    lines.append(pad + "fut.result()")
+    t0 = _time.monotonic()
+    findings = lint_source("\n".join(lines) + "\n", "scratch.py",
+                           only=["rpc-result-no-timeout"])
+    assert _time.monotonic() - t0 < 1.0
+    assert [f.rule for f in findings] == ["rpc-result-no-timeout"]
+
+
+def test_rpc_payload_unserializable_flagged():
+    findings = _lint(
+        """
+        import threading
+
+        def go(rpc):
+            rpc.define("svc::step", lambda x: x)
+            rpc.async_("peer", "svc::step", lambda: 1)
+            rpc.async_("peer", "svc::step", (i for i in range(3)))
+            rpc.async_("peer", "svc::step", threading.Lock())
+            rpc.async_("peer", "svc::step", open("f.txt"))
+            lk = threading.Lock()
+            rpc.async_("peer", "svc::step", [lk])
+        """
+    )
+    assert _rules_of(findings).count("rpc-payload-unserializable") == 5
+    assert "rpc-endpoint-arity" not in _rules_of(findings)
+
+
+def test_rpc_payload_consumed_lambda_and_rebind_ok():
+    clean = _lint(
+        """
+        import threading
+
+        def go(rpc, xs):
+            rpc.define("svc::step", lambda x: x)
+            # Lambda consumed by sorted() BEFORE serialization: fine.
+            rpc.async_("peer", "svc::step", sorted(xs, key=lambda v: v))
+            lk = threading.Lock()
+            lk = 3  # rebound to a picklable value before the call
+            rpc.async_("peer", "svc::step", lk)
+        """
+    )
+    assert "rpc-payload-unserializable" not in _rules_of(clean)
+
+
+def test_rpc_payload_tracer_inside_jit_flagged():
+    findings = _lint(
+        """
+        import jax
+
+        def setup(rpc):
+            rpc.define("svc::step", lambda x: x)
+
+            @jax.jit
+            def step(x):
+                rpc.async_("peer", "svc::step", x)
+                return x
+        """
+    )
+    assert "rpc-payload-unserializable" in _rules_of(findings)
+    clean = _lint(
+        """
+        import jax
+
+        def setup(rpc):
+            rpc.define("svc::step", lambda x: x)
+
+            @jax.jit
+            def step(x):
+                return x * 2
+
+            def ship(x):
+                rpc.async_("peer", "svc::step", x)  # not traced: fine
+        """
+    )
+    assert "rpc-payload-unserializable" not in _rules_of(clean)
+
+
+def test_rpc_result_no_timeout_flagged_and_clean():
+    findings = _lint(
+        """
+        def go(rpc):
+            rpc.define("svc::step", lambda x: x)
+            fut = rpc.async_("peer", "svc::step", 1)
+            a = fut.result()                                    # bare: flag
+            b = rpc.async_("peer", "svc::step", 2).result()     # chained: flag
+            return a, b
+        """
+    )
+    assert _rules_of(findings).count("rpc-result-no-timeout") == 2
+    clean = _lint(
+        """
+        def go(rpc, pool):
+            rpc.define("svc::step", lambda x: x)
+            fut = rpc.async_("peer", "svc::step", 1)
+            a = fut.result(timeout=5)     # bounded: fine
+            b = fut.result(0)             # poll: fine
+            other = pool.submit(print)
+            c = other.result()            # origin not RPC: silent
+            fut = 3
+            d = fut.result()              # rebound: origin cleared
+            return a, b, c, d
+        """
+    )
+    assert "rpc-result-no-timeout" not in _rules_of(clean)
+
+
+def test_rpc_result_no_timeout_through_return_hop_and_self_attr():
+    findings = _lint(
+        """
+        class Client:
+            def ship(self, rpc, unroll):
+                return rpc.async_("learner", "unroll", unroll)
+
+            def go(self, rpc, unroll):
+                rpc.define_queue("unroll")
+                self.pending = rpc.async_("learner", "unroll", unroll)
+                self.pending.result()          # self-attr flow: flag
+                fut = self.ship(rpc, unroll)   # one hop through a return
+                fut.result()                   # flag
+        """
+    )
+    assert _rules_of(findings).count("rpc-result-no-timeout") == 2
+
+
+def test_rpc_result_no_timeout_loop_backedge():
+    """An RPC future started late in a loop body is awaited bare at the
+    top of the next iteration — the remote-actors shape."""
+    findings = _lint(
+        """
+        def go(rpc):
+            rpc.define_queue("unroll")
+            ship = None
+            while True:
+                if ship is not None:
+                    ship.result()
+                ship = rpc.async_("learner", "unroll", [1])
+        """
+    )
+    assert "rpc-result-no-timeout" in _rules_of(findings)
+
+
+def test_wire_cross_module_endpoint_resolution(tmp_path):
+    """Define in module A with an f-string prefix pattern, call from
+    module B by literal name: the project-wide registry resolves it; a
+    typo'd sibling call is flagged with cross-module knowledge."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "server.py").write_text(textwrap.dedent(
+        """
+        class Server:
+            def __init__(self, rpc, name):
+                rpc.define(f"{name}::go", self._go)
+
+            def _go(self, a, b):
+                return a + b
+        """
+    ))
+    (pkg / "client.py").write_text(textwrap.dedent(
+        """
+        def call(rpc):
+            return rpc.async_("peer", "svc::go", 1, 2).result(5.0)
+
+        def typo(rpc):
+            return rpc.async_("peer", "svc::goo", 1, 2).result(5.0)
+
+        def skew(rpc):
+            return rpc.async_("peer", "svc::go", 1, 2, 3).result(5.0)
+        """
+    ))
+    findings = lint_paths([pkg], root=tmp_path)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert len(by_rule.pop("rpc-endpoint-unknown")) == 1
+    assert len(by_rule.pop("rpc-endpoint-arity")) == 1
+    assert by_rule == {}, by_rule
+
+
+def test_wire_rule_line_suppression():
+    src = """
+    def go(rpc):
+        rpc.define("svc::step", lambda x: x)
+        fut = rpc.async_("peer", "svc::nope")  # moolint: disable=rpc-endpoint-unknown
+        return fut.result()  # moolint: disable=rpc-result-no-timeout
+    """
+    assert _lint(src) == []
+    src_wrong = src.replace("disable=rpc-result-no-timeout",
+                            "disable=rpc-endpoint-arity")
+    assert "rpc-result-no-timeout" in _rules_of(_lint(src_wrong))
+
+
+def test_wire_baselines_are_empty():
+    """The PR 3 burn-down contract: both checked-in baselines grandfather
+    nothing, forever (ci_check.sh enforces the same via --fail-nonempty)."""
+    for path in (BASELINE, BASELINE_TOOLS):
+        if not path.exists():
+            pytest.skip("baseline not checked in")
+        assert load_baseline(path)["findings"] == [], path
+
+
 def test_line_suppression_comment():
     src = """
     import asyncio

@@ -140,7 +140,6 @@ def test_timeout_wheel_scales_to_10k_in_flight():
     client._poke_min = 30.0  # no pokes inside the observation window
     client.connect(host.debug_info()["listen"][0])
     try:
-        assert client.sync("host", "hold0", *[]) if False else True
         # Warm the route.
         warm = client.async_("host", "hold", -1)
         t0 = time.monotonic()

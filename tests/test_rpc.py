@@ -74,14 +74,17 @@ def test_remote_exception(pair):
 def test_unknown_function(pair):
     host, client = pair
     with pytest.raises(RpcError, match="not found"):
-        client.sync("host", "nope")
+        # Deliberately undefined endpoint: the FNF path IS the test.
+        client.sync("host", "nope")  # moolint: disable=rpc-endpoint-unknown
 
 
 def test_unknown_peer_times_out():
     rpc = Rpc("lonely")
     rpc.set_timeout(0.5)
     try:
-        fut = rpc.async_("ghost", "fn")
+        # Endpoint never defined anywhere: the unknown-peer timeout is
+        # what this test exercises.
+        fut = rpc.async_("ghost", "fn")  # moolint: disable=rpc-endpoint-unknown
         with pytest.raises(RpcError, match="timed out"):
             fut.result(timeout=10)
     finally:
@@ -131,8 +134,10 @@ def test_pickled_custom_class(pair):
 def test_undefine(pair):
     host, client = pair
     host.define("temp", lambda: 1)
+    assert host.defined("temp")
     assert client.sync("host", "temp") == 1
     host.undefine("temp")
+    assert not host.defined("temp")
     with pytest.raises(RpcError, match="not found"):
         client.sync("host", "temp")
 
@@ -203,6 +208,10 @@ def test_batched_define(pair, rng):
 
     def batched(x):
         calls.append(x.shape[0])
+        # Hold the (single) batch worker briefly so later calls pile up in
+        # the queue: without this the assertion below is a timing race —
+        # a fast loop serves every call as a singleton batch under load.
+        time.sleep(0.02)
         return x * 2
 
     host.define("bdouble", batched, batch_size=8)

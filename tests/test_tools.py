@@ -1,13 +1,19 @@
 """Launcher + plotter + tsv-record tests (reference: the launcher/plotter
-scripts of examples/, exercised at function level)."""
+scripts of examples/, exercised at function level), plus moolint CLI
+tooling contracts (output formats, self-runtime budget)."""
 
 import os
 import subprocess
 import sys
+import time
+from pathlib import Path
 
 from moolib_tpu.examples.common.record import TsvLogger, write_metadata
 from moolib_tpu.examples.launch import write_sbatch
 from moolib_tpu.examples.plot import read_tsv, render
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+MOOLINT = REPO_ROOT / "tools" / "moolint.py"
 
 
 def test_tsv_logger_roundtrip(tmp_path):
@@ -98,3 +104,46 @@ def test_profile_trace_capture(tmp_path):
     p2 = StepWindowProfiler(None)
     p2.step(0)
     p2.close()
+
+
+def test_moolint_gha_format_annotations(tmp_path):
+    """--format=gha emits GitHub ::error workflow-command lines for NEW
+    findings (the ci_check.sh GITHUB_ACTIONS path)."""
+    bad = tmp_path / "scratch.py"
+    bad.write_text(
+        "import asyncio\nimport time\n\n"
+        "async def handler():\n    time.sleep(1)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--format=gha", str(bad)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("::error ")]
+    assert len(lines) == 1
+    assert "line=5," in lines[0]
+    assert "async-blocking-call" in lines[0]
+    # --json stays an alias for --format=json; mixing contradictory
+    # formats is rejected rather than silently picking one.
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--json", "--format=gha", str(bad)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "conflicts" in proc.stderr
+
+
+def test_moolint_whole_repo_runtime_budget():
+    """The full ci_check.sh lint surface (package tree + tools/ + tests/,
+    all rule families) must stay under 20s on this runner: moolint is a
+    tier-1 gate and a slow linter stops being run."""
+    from moolib_tpu.analysis import lint_paths
+
+    t0 = time.monotonic()
+    lint_paths([REPO_ROOT / "moolib_tpu"], root=REPO_ROOT)
+    lint_paths([REPO_ROOT / "tools", REPO_ROOT / "tests"], root=REPO_ROOT)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 20.0, (
+        f"whole-repo moolint run took {elapsed:.1f}s (budget: 20s); "
+        "profile the newest rule family before landing it"
+    )

@@ -6,19 +6,26 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# On GitHub runners, emit ::error workflow annotations so new findings
+# surface inline on the PR diff; plain text everywhere else.
+fmt=text
+if [ -n "${GITHUB_ACTIONS:-}" ]; then fmt=gha; fi
+
 echo "== moolint: moolib_tpu/ =="
-python tools/moolint.py --check moolib_tpu/
+python tools/moolint.py --check --format="$fmt" moolib_tpu/
 
 echo "== moolint: tools/ tests/ =="
 # Separate baseline section for the non-package trees: they are held to
 # their own (currently empty) grandfather list so debt there can never
 # hide behind the package baseline — and vice versa.
-python tools/moolint.py --check \
+python tools/moolint.py --check --format="$fmt" \
   --baseline moolib_tpu/analysis/baseline_tools.json tools/ tests/
 
-echo "== moolint: baseline burn-down =="
-python tools/moolint.py --baseline-stats
-python tools/moolint.py --baseline-stats \
+echo "== moolint: baselines must stay empty =="
+# The burn-down hit 0 in PR 3; --fail-nonempty turns any regression (a
+# re-grandfathered finding sneaking back in) into a hard CI failure.
+python tools/moolint.py --baseline-stats --fail-nonempty
+python tools/moolint.py --baseline-stats --fail-nonempty \
   --baseline moolib_tpu/analysis/baseline_tools.json
 
 echo "== tier-1 tests =="

@@ -8,7 +8,8 @@ Usage:
     python tools/moolint.py --baseline-update     # re-grandfather findings
     python tools/moolint.py --baseline-stats      # burn-down counters
     python tools/moolint.py --list-rules
-    python tools/moolint.py --json moolib_tpu/
+    python tools/moolint.py --format=json moolib_tpu/   # (--json: alias)
+    python tools/moolint.py --format=gha moolib_tpu/    # ::error annotations
 
 Exit codes: 0 clean against the baseline, 1 new findings, 2 usage/engine
 error. A stale baseline (entries the tree no longer has) warns but stays
@@ -53,13 +54,29 @@ def main(argv=None) -> int:
                     help="print the grandfathered-finding count (per rule "
                          "and per file) so the burn-down is visible in CI "
                          "output, then exit")
+    ap.add_argument("--fail-nonempty", action="store_true",
+                    help="with --baseline-stats: exit 1 when any "
+                         "grandfathered finding remains — the burn-down "
+                         "reached 0 and the baseline must stay empty")
     ap.add_argument("--list-rules", action="store_true",
                     help="list registered rules and exit")
     ap.add_argument("--only", action="append", default=None, metavar="RULE",
                     help="run only these rules (repeatable / comma lists)")
+    ap.add_argument("--format", choices=("text", "json", "gha"),
+                    default=None, dest="fmt",
+                    help="output format: text (default), json "
+                         "(machine-readable), gha (GitHub workflow "
+                         "::error annotations for new findings)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output")
+                    help="alias for --format=json")
     args = ap.parse_args(argv)
+    if args.fmt is None:
+        args.fmt = "json" if args.as_json else "text"
+    elif args.as_json and args.fmt != "json":
+        print("moolint: error: --json conflicts with "
+              f"--format={args.fmt}", file=sys.stderr)
+        return 2
+    args.as_json = args.fmt == "json"
 
     if args.list_rules:
         for rule in all_rules():
@@ -133,7 +150,16 @@ def main(argv=None) -> int:
         }, indent=1))
     else:
         for f in new:
-            print(str(f))
+            if args.fmt == "gha":
+                # GitHub workflow-command annotation: surfaces on the PR
+                # diff at the offending line. Newlines would terminate the
+                # command mid-message, so escape per the GHA spec.
+                msg = f"{f.rule}: {f.message}".replace("%", "%25") \
+                    .replace("\r", "%0D").replace("\n", "%0A")
+                print(f"::error file={f.path},line={f.line},"
+                      f"col={f.col + 1},title=moolint::{msg}")
+            else:
+                print(str(f))
         grandfathered = len(findings) - len(new)
         print(
             f"moolint: {len(findings)} finding(s): {len(new)} new, "
@@ -157,6 +183,7 @@ def baseline_stats(args) -> int:
         return 2
     entries = baseline.get("findings", [])
     total = sum(int(e.get("count", 1)) for e in entries)
+    rc = 1 if (args.fail_nonempty and total) else 0
     per_rule: dict = {}
     per_file: dict = {}
     for e in entries:
@@ -170,14 +197,19 @@ def baseline_stats(args) -> int:
             "per_rule": per_rule,
             "per_file": per_file,
         }, indent=1))
-        return 0
-    print(f"moolint: baseline {args.baseline.name}: {total} grandfathered "
-          f"finding(s) across {len(per_file)} file(s)")
-    for rule, n in sorted(per_rule.items(), key=lambda kv: -kv[1]):
-        print(f"  {n:4d}  {rule}")
-    for path, n in sorted(per_file.items(), key=lambda kv: -kv[1]):
-        print(f"  {n:4d}  {path}")
-    return 0
+    else:
+        print(f"moolint: baseline {args.baseline.name}: {total} "
+              f"grandfathered finding(s) across {len(per_file)} file(s)")
+        for rule, n in sorted(per_rule.items(), key=lambda kv: -kv[1]):
+            print(f"  {n:4d}  {rule}")
+        for path, n in sorted(per_file.items(), key=lambda kv: -kv[1]):
+            print(f"  {n:4d}  {path}")
+    if rc:
+        print(f"moolint: error: {args.baseline} grandfathers {total} "
+              "finding(s); the burn-down reached 0 in PR 3 and the "
+              "baseline must stay empty — fix or suppress (with a reason) "
+              "instead of re-baselining", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":
