@@ -243,6 +243,14 @@ class Accumulator:
         self._electing = False
         self._synced = False                     # model state is current
         self._state_req_inflight = False
+        self._state_req_at = 0.0                 # watchdog for the above
+        self._state_req_token = 0                # supersession for the above
+        # Consecutive collective failures observed while the broker was
+        # dark: once nonzero, new rounds/elections are deferred until the
+        # broker returns (membership cannot heal without it, so every new
+        # round could only join the timeout queue). Reset on any success,
+        # epoch reset, or broker recovery (the gate checks liveness too).
+        self._dark_failures = 0
 
         self._seq = 0                            # count-round sequence
         self._attempt = 0                        # retry suffix for count keys
@@ -440,15 +448,44 @@ class Accumulator:
         with self._lock:
             if sync_id != self._epoch:
                 self._reset_epoch(sync_id)
+            # Leader loss without an epoch change should be impossible
+            # (the broker always mints a fresh sync id when membership
+            # changes) — but a vanished leader would wedge state sync and
+            # every future round, so verify and force re-election rather
+            # than trust the invariant under chaos.
+            if (self._leader is not None
+                    and not self._electing
+                    and self.group.active()
+                    and self._leader not in self.group.members):
+                log.warning(
+                    "%s: leader %s vanished from the member list — "
+                    "forcing re-election", self.rpc.get_name(), self._leader,
+                )
+                self._leader = None
+            # Broker-dark degradation: collectives are peer-to-peer and
+            # keep working while the broker is down — but once one FAILS
+            # with the broker dark, the membership view is provably
+            # unhealable until the broker returns, so starting more
+            # rounds/elections would only queue more guaranteed timeouts.
+            broker_dark = not self.group.broker_connected()
+            degraded = broker_dark and self._dark_failures > 0
             if self._electing or self._leader is None:
-                self._maybe_elect()
+                if not degraded:
+                    self._maybe_elect()
                 return
             if not self._synced:
+                # Watchdog: a state request to a vanished leader errors
+                # only at the full RPC timeout; write it off after the
+                # group timeout so re-election/resync is not gated on it.
+                if (self._state_req_inflight
+                        and time.monotonic() - self._state_req_at
+                        > max(self.group.timeout, 5.0)):
+                    self._state_req_inflight = False
                 self._maybe_request_state()
             # Drive one count round at a time; unsynced/idle peers
             # contribute zeros so collectives never stall. With pipelining,
             # counting continues while gradient rounds are still reducing.
-            if not self._round_inflight and (
+            if not degraded and not self._round_inflight and (
                 self._parallel > 1 or self._grads_inflight == 0
             ):
                 self._start_count_round()
@@ -468,6 +505,7 @@ class Accumulator:
         self._gseq = 0
         self._round_inflight = False
         self._grads_inflight = 0
+        self._dark_failures = 0
         self._grad_outcomes.clear()
         self._release_gseq = 0
         self._cumulative_bs = 0
@@ -503,12 +541,14 @@ class Accumulator:
                 with self._lock:
                     self._electing = False  # retried next update()
                     if self._epoch == epoch:
+                        self._dark_failures += 1
                         log.debug("election failed: %s", e)
                 return
             with self._lock:
                 if self._epoch != epoch:
                     return
                 self._electing = False
+                self._dark_failures = 0
                 self._leader = leader
                 if leader == self.rpc.get_name():
                     self._synced = True
@@ -554,11 +594,19 @@ class Accumulator:
         leader = self._leader
         if leader is None or leader == self.rpc.get_name():
             return
+        self._state_req_at = time.monotonic()
+        self._state_req_token += 1
+        token = self._state_req_token
         self._state_req_inflight = True
         epoch = self._epoch
 
         def on_state(result, error):
             with self._lock:
+                if token != self._state_req_token:
+                    # Superseded: the watchdog wrote this request off and a
+                    # newer one owns the gate — applying this (possibly
+                    # older) snapshot now could regress applied state.
+                    return
                 self._state_req_inflight = False
                 if self._epoch != epoch:
                     return
@@ -569,7 +617,7 @@ class Accumulator:
             # Apply outside the lock: user callback may be slow (device_put).
             self._set_state(result["state"])
             with self._lock:
-                if self._epoch == epoch:
+                if self._epoch == epoch and token == self._state_req_token:
                     self._model_version = version
                     self._result_version = version
                     self._synced = True
@@ -750,6 +798,7 @@ class Accumulator:
                     restore_snapshot_locked()
                     if self._epoch == epoch:
                         self._round_inflight = False
+                        self._dark_failures += 1  # gates retries if dark
                         # Retry under a fresh key: parked partials from the
                         # failed attempt must never merge into the retry.
                         self._attempt += 1
@@ -835,6 +884,7 @@ class Accumulator:
                 restore_snapshot_locked()
                 return
             self._round_inflight = False
+            self._dark_failures = 0
             self._seq = seq + 1
             # A count round resolved the current wants_gradients poll;
             # peers may contribute again toward the (still unfilled)
@@ -941,6 +991,7 @@ class Accumulator:
                 with self._lock:
                     if self._epoch == epoch:
                         settle_locked(None)
+                        self._dark_failures += 1
                         # Peers that completed this round applied an update we
                         # missed: our params are now stale. Force a state
                         # re-request from the leader instead of training on.
@@ -951,6 +1002,7 @@ class Accumulator:
             with self._lock:
                 if self._epoch != epoch:
                     return
+                self._dark_failures = 0
                 if total_bundle is None:
                     settle_locked(None)  # nobody contributed
                     return
@@ -1010,6 +1062,8 @@ class Accumulator:
                 "parallel_gradients": self._parallel,
                 "leader": self._leader,
                 "synced": self._synced,
+                "broker_connected": self.group.broker_connected(),
+                "dark_failures": self._dark_failures,
             }
 
     def close(self):

@@ -136,11 +136,20 @@ class Group:
         self.group_name = group_name
         self.timeout = timeout
         self.sort_order = sort_order
+        # Broker-dark grace: how long the last sync stays trusted after
+        # the broker goes silent. Within the window the group keeps its
+        # membership (peer-to-peer collectives still work without the
+        # broker); past it, callers (e.g. the Accumulator) should degrade
+        # instead of queueing rounds that can only time out.
+        self.broker_grace = max(3.0 * timeout, 15.0)
+        self._grace_explicit = False  # set_broker_grace pins it
         self._lock = threading.RLock()
         self._sync_id: Optional[str] = None
         self._members: List[str] = []
         self._last_ping = 0.0
         self._ping_inflight = False
+        self._last_broker_contact = time.monotonic()  # optimistic start
+        self._broker_dark_logged = False
         self._active: Dict[str, _Op] = {}
         self._parked: Dict[str, List[tuple]] = {}
         self._shared_state(rpc).register(self)
@@ -199,17 +208,44 @@ class Group:
         self.broker_name = str(name)
         self._ping_inflight = False
         self._last_ping = 0.0
+        # Fresh authority, fresh grace window.
+        self._last_broker_contact = time.monotonic()
+        self._broker_dark_logged = False
 
     def set_timeout(self, seconds: float):
         """Collective/membership timeout (reference: Group::setTimeout,
-        src/moolib.cc:2257)."""
+        src/moolib.cc:2257). Re-derives the broker grace window unless it
+        was pinned by an explicit ``set_broker_grace``."""
         self.timeout = float(seconds)
+        if not self._grace_explicit:
+            self.broker_grace = max(3.0 * self.timeout, 15.0)
 
     def set_sort_order(self, order: int):
         """Member-list sort priority carried with pings — lower sorts
         first, influencing rank/tree position (reference:
         Group::setSortOrder, src/moolib.cc:2258)."""
         self.sort_order = int(order)
+
+    def set_broker_grace(self, seconds: float):
+        """How long the last membership sync stays trusted while the
+        broker is unreachable (see ``broker_connected``). Pins the value:
+        later ``set_timeout`` calls no longer re-derive it."""
+        self.broker_grace = float(seconds)
+        self._grace_explicit = True
+
+    def broker_silence(self) -> float:
+        """Seconds since the broker was last heard from (a pong or a
+        membership push)."""
+        return time.monotonic() - self._last_broker_contact
+
+    def broker_connected(self) -> bool:
+        """True while the broker has been heard from within the grace
+        window. The group keeps its last sync either way — a dark broker
+        cannot change membership, so the sorted member list (and every
+        peer's tree position) stays valid until the broker returns and
+        pushes a fresh epoch; peers rejoin with their same sort order via
+        the very next ping."""
+        return self.broker_silence() <= self.broker_grace
 
     def name(self) -> str:
         """Group name (reference: Group::name, src/moolib.cc:2261)."""
@@ -238,6 +274,15 @@ class Group:
         """Heartbeat; call regularly from the training loop
         (reference: GroupService::update client side, src/group.h:394-490)."""
         now = time.monotonic()
+        # Ping-gate watchdog: a ping to a dead/restarting broker errors
+        # only at the full RPC timeout (~30s), which would gate the NEXT
+        # ping — and therefore rejoin after a broker restart — behind it.
+        # Write the ping off as lost after a few intervals instead; a
+        # late pong is harmless (membership is epoch-keyed).
+        if (self._ping_inflight
+                and now - self._last_ping
+                > max(4.0 * self._PING_INTERVAL, min(self.timeout, 10.0))):
+            self._ping_inflight = False
         if not self._ping_inflight and now - self._last_ping >= self._PING_INTERVAL:
             self._ping_inflight = True
             self._last_ping = now
@@ -246,6 +291,9 @@ class Group:
                 self._ping_inflight = False
                 if error is not None:
                     log.debug("broker ping failed: %s", error)
+                else:
+                    self._last_broker_contact = time.monotonic()
+                    self._broker_dark_logged = False
 
             try:
                 self.rpc.async_callback(
@@ -259,9 +307,22 @@ class Group:
                 # on_pong will never run to clear it.
                 self._ping_inflight = False
                 raise
+        if not self.broker_connected() and not self._broker_dark_logged:
+            self._broker_dark_logged = True
+            log.warning(
+                "group %s: broker %r silent for %.1fs (grace %.1fs) — "
+                "keeping last membership (%d members), rejoining on the "
+                "next pong with sort_order=%d",
+                self.group_name, self.broker_name, self.broker_silence(),
+                self.broker_grace, len(self._members), self.sort_order,
+            )
         self._expire_ops()
 
     def _apply_sync(self, sync_id: str, members: List[str]):
+        # A push IS broker contact (restarted brokers push before the
+        # next pong lands).
+        self._last_broker_contact = time.monotonic()
+        self._broker_dark_logged = False
         with self._lock:
             if sync_id == self._sync_id:
                 self._members = list(members)
@@ -311,12 +372,20 @@ class Group:
                 if not self._parked[key]:
                     del self._parked[key]
         if expired:
+            # Diagnosability under partial failure: a round that starves
+            # because membership cannot heal (broker dark) reads
+            # differently from one that starved under a live broker (a
+            # slow/partitioned peer).
+            dark = "" if self.broker_connected() else (
+                f" (broker silent for {self.broker_silence():.1f}s — "
+                "membership cannot heal until it returns)"
+            )
             pool = _completion_executor()
             for op in expired:
                 # Fire-and-forget by design: _set_exception never raises.
                 pool.submit(  # moolint: disable=dropped-future
                     op.future._set_exception,
-                    RpcError(f"allreduce {op.key} timed out"),
+                    RpcError(f"allreduce {op.key} timed out{dark}"),
                 )
 
     # -- allreduce -----------------------------------------------------------

@@ -577,6 +577,16 @@ class Rpc:
         self._explicit: Dict[str, dict] = {}  # addr -> {conn, last_try}
         self._closed = False
         self._batchers: Dict[str, Any] = {}
+        # Fault-injection hooks (moolib_tpu/rpc/faults.py contract) — None
+        # in production, so every seam is a single attribute check.
+        self._faults = None
+        # Explicit-reconnect backoff: capped exponential with FULL jitter
+        # (delay ~ U[0, backoff]) so a healed partition never produces a
+        # synchronized redial stampede across the cohort. Seedable for
+        # deterministic tests via set_reconnect_backoff.
+        self._dial_backoff_base = 0.5
+        self._dial_backoff_cap = 5.0
+        self._dial_rng = _pyrandom.Random()
 
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=_executor_workers(), thread_name_prefix=f"{self._name}-fn"
@@ -630,6 +640,32 @@ class Rpc:
         """Silence probe cadence; a connection that stays silent for 4
         intervals is closed and its in-flight calls re-routed."""
         self._keepalive_interval = float(seconds)
+
+    def set_reconnect_backoff(self, base: float = 0.5, cap: float = 5.0,
+                              seed: Optional[int] = None):
+        """Tune (and optionally seed) the explicit-reconnect backoff.
+
+        After each failed dial of a ``connect()``-registered address the
+        backoff doubles from ``base`` up to ``cap``; the actual wait is
+        drawn uniformly from [0, backoff] (full jitter), so a cohort of
+        peers redialing one healed endpoint spreads its attempts instead
+        of stampeding in lockstep. A successful dial resets to ``base``.
+        ``seed`` makes the jitter sequence deterministic for tests."""
+        if base <= 0 or cap < base:
+            raise RpcError("need 0 < base <= cap")
+        self._dial_backoff_base = float(base)
+        self._dial_backoff_cap = float(cap)
+        if seed is not None:
+            self._dial_rng = _pyrandom.Random(seed)
+
+    def install_fault_hooks(self, hooks):
+        """Install a fault-injection hooks object (the
+        :mod:`moolib_tpu.rpc.faults` contract) on this Rpc's wire seams.
+        Testing-only: hooks run inline on the IO loop for every message."""
+        self._faults = hooks
+
+    def uninstall_fault_hooks(self):
+        self._faults = None
 
     def set_transports(self, transports):
         ts = set(transports)
@@ -695,6 +731,11 @@ class Rpc:
                 return  # idempotent: never reset a live registration
             self._explicit[addr] = {
                 "conn": None, "last_try": 0.0, "dialing": False,
+                # Capped exponential backoff + full jitter (see
+                # set_reconnect_backoff): "backoff" is the current ceiling,
+                # "delay" the jittered wait before the next redial.
+                "backoff": self._dial_backoff_base,
+                "delay": 0.0,
             }
             self._loop.create_task(self._dial_explicit(addr))
 
@@ -716,6 +757,21 @@ class Rpc:
             if conn is not None:
                 conn.explicit_addr = addr
                 entry["conn"] = conn
+                # Success: reset the schedule. A later drop redials after
+                # ~base (not instantly — a crash-looping peer would turn
+                # instant redials into a tight connect spin).
+                entry["backoff"] = self._dial_backoff_base
+                entry["delay"] = self._dial_backoff_base
+            else:
+                # Failure: full jitter over the current ceiling, then
+                # double the ceiling (capped). Jitter over the WHOLE
+                # interval — not [b/2, b] — is what de-synchronizes a
+                # cohort that lost the same endpoint at the same instant.
+                backoff = entry.get("backoff", self._dial_backoff_base)
+                entry["delay"] = self._dial_rng.uniform(0.0, backoff)
+                entry["backoff"] = min(
+                    self._dial_backoff_cap, backoff * 2.0
+                )
         finally:
             entry["dialing"] = False
 
@@ -764,10 +820,61 @@ class Rpc:
 
     # -- wire ----------------------------------------------------------------
 
+    def _fault_send_consumed(self, conn: _Conn, frames: List[Any]) -> bool:
+        """Consult the installed fault hooks for an outgoing message —
+        LOOP THREAD ONLY. Returns True when the hooks consumed the send
+        (dropped or rescheduled it); the caller then reports success, so
+        an injected drop is indistinguishable from network loss."""
+        faults = self._faults
+        if faults is None:
+            return False
+        from .faults import frame_ids
+
+        try:
+            rid, fid = frame_ids(frames)
+            action, arg = faults.filter_send(self, conn, rid, fid, frames)
+        except (asyncio.CancelledError,
+                concurrent.futures.CancelledError):
+            raise  # never swallow task cancellation
+        except Exception as e:
+            # A buggy scenario must not silently corrupt the experiment:
+            # surface it as a protocol error on this connection.
+            log.error("fault hook failed on send: %s", e)
+            self._drop_conn(conn, f"fault hook error: {e}")
+            return True
+        if action == "drop":
+            conn.last_send = time.monotonic()
+            return True
+        if action == "delay":
+            conn.last_send = time.monotonic()
+            self._loop.call_later(
+                float(arg), self._fault_write_later, conn, frames
+            )
+            return True
+        if action == "dup":
+            for _ in range(int(arg)):
+                self._loop.call_soon(self._fault_write_later, conn, frames)
+        return False  # pass (and the dup original) proceed normally
+
+    def _fault_write_later(self, conn: _Conn, frames: List[Any]):
+        """Deferred raw write for injected delay/duplicate deliveries.
+        Bypasses the hooks (the verdict already happened) and flow
+        control (chaos traffic is test-sized)."""
+        if self._closed or conn.is_closing():
+            return
+        try:
+            conn.sock.writelines(frames)
+            conn.last_send = time.monotonic()
+        except (ConnectionError, OSError) as e:
+            self._drop_conn(conn, f"write failed: {e}")
+
     async def _write(self, conn: _Conn, frames: List[Any]):
         try:
             if conn.is_closing():
                 raise ConnectionError("connection is closing")
+            if self._faults is not None and \
+                    self._fault_send_consumed(conn, frames):
+                return
             conn.sock.writelines(frames)
             conn.last_send = time.monotonic()
             # Flow control: wait while the transport's write buffer is above
@@ -789,6 +896,9 @@ class Rpc:
         """
         if conn.is_closing() or not conn.proto._can_write.is_set():
             return False
+        if self._faults is not None and \
+                self._fault_send_consumed(conn, frames):
+            return True  # consumed by injection == "sent" to the caller
         try:
             conn.sock.writelines(frames)
             conn.last_send = time.monotonic()
@@ -802,6 +912,17 @@ class Rpc:
                   self._name, conn.transport,
                   "out" if conn.outbound else "in",
                   conn.peer_name, conn.is_closing(), why)
+        if self._faults is not None:
+            # Observation-only: scenario engines log the teardown. Hook
+            # errors are swallowed here on purpose — _drop_conn must
+            # complete (it runs inside error paths already).
+            try:
+                self._faults.on_conn_drop(self, conn, why)
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # never swallow task cancellation
+            except Exception as e:
+                log.error("fault hook failed on conn drop: %s", e)
         conn.close()
         if conn in self._anon_conns:
             self._anon_conns.remove(conn)
@@ -832,6 +953,27 @@ class Rpc:
     # -- dispatch ------------------------------------------------------------
 
     def _dispatch(self, conn: _Conn, rid: int, fid: int, obj):
+        faults = self._faults
+        if faults is not None:
+            # Recv seam: a hook exception propagates into the frame
+            # protocol's dispatch guard, which drops the connection — a
+            # buggy scenario surfaces as a protocol error, never silence.
+            action, arg = faults.filter_recv(self, conn, rid, fid, obj)
+            if action == "drop":
+                return
+            if action == "delay":
+                self._loop.call_later(
+                    float(arg), self._dispatch_now, conn, rid, fid, obj
+                )
+                return
+            if action == "dup":
+                for _ in range(int(arg)):
+                    self._loop.call_soon(
+                        self._dispatch_now, conn, rid, fid, obj
+                    )
+        self._dispatch_now(conn, rid, fid, obj)
+
+    def _dispatch_now(self, conn: _Conn, rid: int, fid: int, obj):
         if fid == FID_GREETING:
             self._on_greeting(conn, obj)
         elif fid == FID_KEEPALIVE:
@@ -1416,11 +1558,14 @@ class Rpc:
                     self._sched_out(
                         out, max(self._next_check(out, now), now + self._TICK)
                     )
-                # re-dial dropped/failed explicit connections
+                # Re-dial dropped/failed explicit connections on their
+                # jittered backoff schedule (see _dial_explicit).
                 for addr, entry in list(self._explicit.items()):
                     conn = entry["conn"]
                     dead = conn is None or conn.is_closing()
-                    if dead and not entry["dialing"] and now - entry["last_try"] > 1.0:
+                    if (dead and not entry["dialing"]
+                            and now - entry["last_try"]
+                            > entry.get("delay", 1.0)):
                         self._loop.create_task(self._dial_explicit(addr))
                 # Keepalive silent conns; tear down half-open ones. Both
                 # sides keepalive when idle, so a healthy peer is never
@@ -1466,6 +1611,21 @@ class Rpc:
                 # stays O(events), not O(in-flight x ticks).
                 "timeout_entries_processed":
                     self._timeout_entries_processed,
+                # Explicit-reconnect schedule (backoff/jitter state), so
+                # tests and operators can see redial pacing per address.
+                # list(): connect() registers entries on the loop thread
+                # while any thread may call debug_info.
+                "explicit": {
+                    addr: {
+                        "connected": (
+                            e["conn"] is not None
+                            and not e["conn"].is_closing()
+                        ),
+                        "backoff": e.get("backoff"),
+                        "delay": e.get("delay"),
+                    }
+                    for addr, e in list(self._explicit.items())
+                },
                 "peers": {}}
         for peer in self._peers.values():
             info["peers"][peer.name] = {

@@ -1,0 +1,22 @@
+"""Testing utilities: deterministic fault injection for the RPC stack.
+
+Kept outside the production packages so importing :mod:`moolib_tpu.rpc`
+never pays for (or accidentally enables) chaos machinery; see
+:mod:`moolib_tpu.testing.chaos`.
+"""
+
+from .chaos import ChaosNet, Event, FaultPlan
+
+__all__ = ["ChaosNet", "Event", "FaultPlan", "SCENARIOS"]
+
+
+def __getattr__(name):
+    # Scenarios pull in the Accumulator lazily — importing the chaos
+    # engine alone must not drag the parallel package (and jax) in.
+    if name == "SCENARIOS":
+        from .scenarios import SCENARIOS
+
+        return SCENARIOS
+    raise AttributeError(
+        f"module 'moolib_tpu.testing' has no attribute {name!r}"
+    )

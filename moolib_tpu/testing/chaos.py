@@ -1,0 +1,477 @@
+"""chaosnet — deterministic fault injection for the RPC/Group/Accumulator
+stack.
+
+Podracer-style architectures treat preemption and peer loss as the steady
+state (PAPERS.md: arXiv 2104.06272); this module makes those conditions a
+first-class, *seeded* input instead of something only the real network can
+produce. A :class:`FaultPlan` composes fault primitives — message
+drop/delay/duplicate/reorder by endpoint-name pattern, bidirectional peer
+partition and heal, per-peer slow links, keepalive blackholes — and a
+:class:`ChaosNet` installs the plan on live :class:`~moolib_tpu.rpc.Rpc`
+instances through the hook contract in :mod:`moolib_tpu.rpc.faults`.
+
+Determinism contract
+--------------------
+
+Every *decision* the plan makes is a pure function of (a) the seed and
+(b) the sequence of messages presented to :meth:`FaultPlan.decide` — no
+wall clock, no global RNG, no ambient state. The plan records every
+injected action in :attr:`FaultPlan.events` (a list of :class:`Event`
+tuples with a monotonically increasing ``seq``), so:
+
+- Replaying the same scripted message sequence through two plans built
+  with the same seed yields byte-identical event logs (asserted in
+  ``tests/test_chaos.py``).
+- A failing scenario reproduces from its seed: rebuild the plan with the
+  same seed and rules, re-run the scenario, diff the logs (see
+  ``docs/reliability.md``).
+
+On a *live* cluster the message sequence itself depends on scheduling
+(keepalive cadence, retry timing), so live event logs are reproducible at
+the decision level, not the interleaving level — the scenario suite
+therefore asserts *invariants* (no duplicate execution, no lost acked
+call, collectives complete-or-error) rather than exact live logs.
+
+Injected faults are indistinguishable from real network behavior at the
+seams: a dropped send updates the sender's bookkeeping exactly as a sent
+message would (so pokes/resends engage), and a duplicated recv re-enters
+dispatch exactly like a transport-level duplicate (so rid suppression is
+what is actually under test).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+from fnmatch import fnmatchcase
+from random import Random
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..rpc.faults import DELAY, DROP, DUP, PASS_VERDICT, Verdict
+from ..rpc.rpc import (
+    FID_ACK,
+    FID_ERROR,
+    FID_FNF,
+    FID_GREETING,
+    FID_KEEPALIVE,
+    FID_LOOKING_FOR_PEER,
+    FID_NACK,
+    FID_PEER_FOUND,
+    FID_POKE,
+    FID_SUCCESS,
+    fid_for,
+)
+from ..utils import get_logger
+
+log = get_logger("chaos")
+
+__all__ = ["Event", "FaultPlan", "ChaosNet", "CONTROL_NAMES"]
+
+#: Control-plane fids get stable ``@``-prefixed endpoint names so rules can
+#: target them by pattern (e.g. ``blackhole_keepalive`` drops "@keepalive").
+CONTROL_NAMES = {
+    FID_GREETING: "@greeting",
+    FID_SUCCESS: "@success",
+    FID_ERROR: "@error",
+    FID_FNF: "@fnf",
+    FID_KEEPALIVE: "@keepalive",
+    FID_LOOKING_FOR_PEER: "@lookingForPeer",
+    FID_PEER_FOUND: "@peerFound",
+    FID_ACK: "@ack",
+    FID_NACK: "@nack",
+    FID_POKE: "@poke",
+}
+
+#: One injected event. ``seq`` is a per-plan monotonic counter; ``arg``
+#: carries the action parameter (delay seconds, duplicate copies), which
+#: for seeded draws (reorder) is itself deterministic from the seed.
+Event = namedtuple("Event", "seq kind action me peer endpoint rid arg")
+
+
+class _Rule:
+    __slots__ = ("kind", "endpoint", "direction", "peer", "p", "arg",
+                 "after", "count", "matched", "fired")
+
+    def __init__(self, kind: str, endpoint: str, direction: str, peer: str,
+                 p: float, arg, after: int, count: Optional[int]):
+        if direction not in ("send", "recv", "both"):
+            raise ValueError(f"bad direction {direction!r}")
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"bad probability {p!r}")
+        self.kind = kind
+        self.endpoint = endpoint
+        self.direction = direction
+        self.peer = peer
+        self.p = p
+        self.arg = arg
+        self.after = int(after)
+        self.count = count
+        self.matched = 0  # messages this rule matched (pre-p, post-after)
+        self.fired = 0    # actions actually injected
+
+    def exhausted(self) -> bool:
+        return self.count is not None and self.fired >= self.count
+
+
+class FaultPlan:
+    """A seeded, composable fault scenario.
+
+    Rule builders return ``self`` so scenarios read as one chain::
+
+        plan = FaultPlan(seed=7).drop("step*", p=0.3).delay("grad*", 0.02)
+
+    Rules are evaluated in declaration order; the first rule that fires
+    wins. Dynamic topology faults (partitions, slow links, keepalive
+    blackholes) are checked before the rule list — a partition is
+    absolute. All state is guarded by one lock: live Rpc loops on several
+    threads consult the same plan concurrently.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rng = Random(self.seed)
+        self._lock = threading.Lock()
+        self._rules: List[_Rule] = []
+        self._seq = 0
+        self.events: List[Event] = []     # injected actions (deterministic)
+        self.observed: List[Event] = []   # organic observations (conn drops)
+        self._partitions: Set[frozenset] = set()
+        self._slow_links: Dict[str, float] = {}
+        self._keepalive_holes: Set[str] = set()
+
+    # -- rule builders --------------------------------------------------------
+
+    def drop(self, endpoint: str = "*", *, direction: str = "send",
+             peer: str = "*", p: float = 1.0, after: int = 0,
+             count: Optional[int] = None) -> "FaultPlan":
+        """Drop matching messages (loss). ``after`` skips the first N
+        matches; ``count`` bounds total injections; ``p`` fires each match
+        with seeded probability."""
+        return self._rule("drop", endpoint, direction, peer, p, None,
+                          after, count)
+
+    def delay(self, endpoint: str = "*", seconds: float = 0.05, *,
+              direction: str = "send", peer: str = "*", p: float = 1.0,
+              after: int = 0, count: Optional[int] = None) -> "FaultPlan":
+        """Delay matching messages by a fixed amount (latency spike)."""
+        return self._rule("delay", endpoint, direction, peer, p,
+                          float(seconds), after, count)
+
+    def duplicate(self, endpoint: str = "*", copies: int = 1, *,
+                  direction: str = "recv", peer: str = "*", p: float = 1.0,
+                  after: int = 0,
+                  count: Optional[int] = None) -> "FaultPlan":
+        """Deliver matching messages ``1 + copies`` times. Defaults to the
+        recv seam: duplicate *delivery* of an already-received rid is the
+        duplicate-suppression contract under test."""
+        return self._rule("duplicate", endpoint, direction, peer, p,
+                          int(copies), after, count)
+
+    def reorder(self, endpoint: str = "*", window: float = 0.05, *,
+                direction: str = "send", peer: str = "*", p: float = 1.0,
+                after: int = 0, count: Optional[int] = None) -> "FaultPlan":
+        """Reorder matching messages by holding each back a seeded-random
+        amount in [0, window) — messages whose draws invert their spacing
+        arrive out of order. The draw consumes the plan RNG, so the delay
+        sequence is deterministic from the seed."""
+        return self._rule("reorder", endpoint, direction, peer, p,
+                          float(window), after, count)
+
+    def _rule(self, kind, endpoint, direction, peer, p, arg, after,
+              count) -> "FaultPlan":
+        with self._lock:
+            self._rules.append(
+                _Rule(kind, endpoint, direction, peer, p, arg, after, count)
+            )
+        return self
+
+    # -- dynamic topology -----------------------------------------------------
+
+    def partition(self, a: str, b: str) -> "FaultPlan":
+        """Bidirectionally drop everything between peers ``a`` and ``b``
+        (including greetings, so reconnects cannot re-bind) until
+        :meth:`heal`."""
+        with self._lock:
+            self._partitions.add(frozenset((a, b)))
+            self._log_locked("partition", "start", a, b, None, None, None)
+        return self
+
+    def heal(self, a: str, b: str) -> "FaultPlan":
+        with self._lock:
+            self._partitions.discard(frozenset((a, b)))
+            self._log_locked("partition", "heal", a, b, None, None, None)
+        return self
+
+    def slow_link(self, peer: str, seconds: float) -> "FaultPlan":
+        """Shape latency: delay every message to/from ``peer`` by
+        ``seconds`` (explicit rules still win — they are checked first)."""
+        with self._lock:
+            self._slow_links[peer] = float(seconds)
+            self._log_locked("slow_link", "start", None, peer, None, None,
+                             float(seconds))
+        return self
+
+    def heal_link(self, peer: str) -> "FaultPlan":
+        with self._lock:
+            self._slow_links.pop(peer, None)
+            self._log_locked("slow_link", "heal", None, peer, None, None,
+                             None)
+        return self
+
+    def blackhole_keepalive(self, peer: str) -> "FaultPlan":
+        """Silently eat keepalives to/from ``peer`` while everything else
+        flows — the half-open-link scenario that liveness probing (4
+        silent intervals -> teardown) exists to detect."""
+        with self._lock:
+            self._keepalive_holes.add(peer)
+            self._log_locked("keepalive_blackhole", "start", None, peer,
+                             None, None, None)
+        return self
+
+    def heal_keepalive(self, peer: str) -> "FaultPlan":
+        with self._lock:
+            self._keepalive_holes.discard(peer)
+            self._log_locked("keepalive_blackhole", "heal", None, peer,
+                             None, None, None)
+        return self
+
+    # -- the decision engine --------------------------------------------------
+
+    def decide(self, direction: str, me: str, peer: Optional[str],
+               endpoint: str, rid: int) -> Verdict:
+        """Verdict for one message — THE deterministic core. Pure in
+        (seed, sequence of calls); every injected action is logged."""
+        with self._lock:
+            # 1. Partitions are absolute (and logged per message: the
+            # event log is the replayable record of what was injected).
+            if peer is not None and frozenset((me, peer)) in self._partitions:
+                self._log_locked("partitioned", DROP, me, peer, endpoint,
+                                 rid, None)
+                return (DROP, None)
+            # 2. Keepalive blackholes: control traffic only.
+            if (peer in self._keepalive_holes
+                    and endpoint == "@keepalive"):
+                self._log_locked("keepalive_blackhole", DROP, me, peer,
+                                 endpoint, rid, None)
+                return (DROP, None)
+            # 3. Declared rules, first fire wins.
+            for rule in self._rules:
+                if rule.exhausted():
+                    continue
+                if rule.direction != "both" and rule.direction != direction:
+                    continue
+                if not fnmatchcase(endpoint, rule.endpoint):
+                    continue
+                if rule.peer != "*" and (
+                    peer is None or not fnmatchcase(peer, rule.peer)
+                ):
+                    continue
+                rule.matched += 1
+                if rule.matched <= rule.after:
+                    continue
+                if rule.p < 1.0 and self._rng.random() >= rule.p:
+                    continue
+                rule.fired += 1
+                if rule.kind == "drop":
+                    self._log_locked("drop", DROP, me, peer, endpoint, rid,
+                                     None)
+                    return (DROP, None)
+                if rule.kind == "delay":
+                    self._log_locked("delay", DELAY, me, peer, endpoint,
+                                     rid, rule.arg)
+                    return (DELAY, rule.arg)
+                if rule.kind == "duplicate":
+                    self._log_locked("duplicate", DUP, me, peer, endpoint,
+                                     rid, rule.arg)
+                    return (DUP, rule.arg)
+                if rule.kind == "reorder":
+                    held = self._rng.uniform(0.0, rule.arg)
+                    self._log_locked("reorder", DELAY, me, peer, endpoint,
+                                     rid, held)
+                    return (DELAY, held)
+            # 4. Slow links shape whatever no rule claimed.
+            if peer is not None and peer in self._slow_links:
+                seconds = self._slow_links[peer]
+                self._log_locked("slow_link", DELAY, me, peer, endpoint,
+                                 rid, seconds)
+                return (DELAY, seconds)
+        return PASS_VERDICT
+
+    # -- bookkeeping ----------------------------------------------------------
+
+    def _log_locked(self, kind, action, me, peer, endpoint, rid, arg):
+        self.events.append(
+            Event(self._seq, kind, action, me, peer, endpoint, rid, arg)
+        )
+        self._seq += 1
+
+    def observe(self, kind: str, me: str, peer: Optional[str], detail: str):
+        """Record an organic observation (kept OUT of the injected-event
+        log so seed-replay comparisons stay exact)."""
+        with self._lock:
+            self.observed.append(
+                Event(len(self.observed), kind, "observe", me, peer, None,
+                      None, detail)
+            )
+
+    def summary(self) -> Dict[str, int]:
+        """Injected-action counts by kind — the soak tool's report unit."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for e in self.events:
+                out[e.kind] = out.get(e.kind, 0) + 1
+            return out
+
+
+class _RpcFaultHooks:
+    """Adapter: one per attached Rpc, translating wire-seam callbacks into
+    :meth:`FaultPlan.decide` calls (the :mod:`moolib_tpu.rpc.faults`
+    contract)."""
+
+    __slots__ = ("_net", "_name")
+
+    def __init__(self, net: "ChaosNet", rpc):
+        self._net = net
+        self._name = rpc.get_name()
+
+    def filter_send(self, rpc, conn, rid, fid, frames) -> Verdict:
+        return self._net.plan.decide(
+            "send", self._name, conn.peer_name,
+            self._net.endpoint_name(fid), rid,
+        )
+
+    def filter_recv(self, rpc, conn, rid, fid, obj) -> Verdict:
+        peer = conn.peer_name
+        if peer is None and fid == FID_GREETING and isinstance(obj, dict):
+            # Greetings are how a conn ACQUIRES its name; match on the
+            # claimed name so partitions block re-binding too.
+            peer = obj.get("name")
+        return self._net.plan.decide(
+            "recv", self._name, peer, self._net.endpoint_name(fid), rid,
+        )
+
+    def on_conn_drop(self, rpc, conn, why: str):
+        self._net.plan.observe("conn_drop", self._name, conn.peer_name, why)
+
+
+class ChaosNet:
+    """Installs a :class:`FaultPlan` on live Rpc instances.
+
+    Usage::
+
+        plan = FaultPlan(seed=7).drop("inc", count=1)
+        with ChaosNet(plan, [client, server]) as net:
+            ...
+            net.kill_conns(client, "server")   # injected conn kill
+
+    Both endpoints of a link should be attached when using partitions:
+    the send seam cannot name a peer before the greeting binds the
+    connection, so partition enforcement for fresh dials happens on the
+    receiver's greeting.
+    """
+
+    def __init__(self, plan: FaultPlan, rpcs=()):
+        self.plan = plan
+        self._rpcs: List[Any] = []
+        self._fid_names: Dict[int, str] = dict(CONTROL_NAMES)
+        self._names_lock = threading.Lock()
+        for rpc in rpcs:
+            self.attach(rpc)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def attach(self, rpc) -> "ChaosNet":
+        rpc.install_fault_hooks(_RpcFaultHooks(self, rpc))
+        self._rpcs.append(rpc)
+        self._absorb_names(rpc)
+        return self
+
+    def detach_all(self):
+        for rpc in self._rpcs:
+            # Sync teardown of a possibly-closed Rpc: uninstall is a plain
+            # attribute clear, nothing cancellable runs here.
+            try:
+                rpc.uninstall_fault_hooks()
+            except Exception:  # moolint: disable=swallow-cancelled
+                pass
+        self._rpcs.clear()
+
+    def __enter__(self) -> "ChaosNet":
+        return self
+
+    def __exit__(self, *exc):
+        self.detach_all()
+
+    # -- endpoint naming ------------------------------------------------------
+
+    def register_endpoints(self, names) -> "ChaosNet":
+        """Teach the net endpoint names not defined on any attached Rpc
+        (fids are hashes — they cannot be inverted, only recognized)."""
+        with self._names_lock:
+            for name in names:
+                self._fid_names[fid_for(name)] = name
+        return self
+
+    def _absorb_names(self, rpc):
+        with self._names_lock:
+            for fid, (name, _fn) in list(rpc._functions.items()):
+                self._fid_names[fid] = name
+
+    def endpoint_name(self, fid: int) -> str:
+        name = self._fid_names.get(fid)
+        if name is not None:
+            return name
+        # Lazy refresh: an endpoint defined after attach (or on a peer
+        # attached later) becomes resolvable the first time it is seen.
+        for rpc in self._rpcs:
+            entry = rpc._functions.get(fid)
+            if entry is not None:
+                with self._names_lock:
+                    self._fid_names[fid] = entry[0]
+                return entry[0]
+        return f"fid:{fid}"
+
+    # -- imperative faults ----------------------------------------------------
+
+    def kill_conns(self, rpc, peer: str = "*", wait: float = 5.0) -> int:
+        """Kill ``rpc``'s live connections to peers matching ``peer`` (an
+        injected connection loss — reconnect/resend machinery takes over).
+        Returns the number of connections killed; blocks up to ``wait``
+        seconds for the teardown to run on the IO loop."""
+        result: Dict[str, int] = {}
+        done = threading.Event()
+
+        def doit():
+            n = 0
+            try:
+                for p in list(rpc._peers.values()):
+                    if not fnmatchcase(p.name, peer):
+                        continue
+                    for conn in list(p.conns.values()):
+                        rpc._drop_conn(conn, "chaos: injected conn kill")
+                        n += 1
+                if peer == "*":
+                    for conn in list(rpc._anon_conns):
+                        rpc._drop_conn(conn, "chaos: injected conn kill")
+                        n += 1
+            finally:
+                result["n"] = n
+                with self.plan._lock:
+                    self.plan._log_locked(
+                        "conn_kill", "kill", rpc.get_name(), peer, None,
+                        None, n,
+                    )
+                done.set()
+
+        rpc._loop.call_soon_threadsafe(doit)
+        if wait:
+            done.wait(wait)
+        return result.get("n", 0)
+
+    def partition(self, a: str, b: str) -> "ChaosNet":
+        self.plan.partition(a, b)
+        return self
+
+    def heal(self, a: str, b: str) -> "ChaosNet":
+        self.plan.heal(a, b)
+        return self

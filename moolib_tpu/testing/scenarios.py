@@ -1,0 +1,269 @@
+"""Canonical chaosnet scenarios — ONE implementation shared by the tier-1
+suite (tests/test_chaos.py) and the soak/CI runner (tools/chaos_soak.py),
+so the invariants CI smokes are exactly the invariants the tests pin and
+neither copy can drift.
+
+Each scenario takes a seed, drives a live in-process cluster through a
+:class:`~moolib_tpu.testing.chaos.FaultPlan`, raises ``AssertionError``
+with a descriptive message on any invariant violation, and returns the
+plan's injected-event summary. Replaying a failure needs only the seed
+(docs/reliability.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+import numpy as np
+
+from ..rpc import Rpc, RpcError
+from ..rpc.broker import Broker
+from ..rpc.group import Group
+from .chaos import ChaosNet, FaultPlan
+
+__all__ = [
+    "MiniCluster",
+    "scenario_drop_storm",
+    "scenario_partition_heal",
+    "scenario_leader_loss",
+    "SCENARIOS",
+]
+
+
+class MiniCluster:
+    """Broker + member peers, all in-process over loopback."""
+
+    def __init__(self):
+        self.broker_rpc = Rpc("broker")
+        self.broker_rpc.listen("127.0.0.1:0")
+        self.addr = self.broker_rpc.debug_info()["listen"][0]
+        self.broker = Broker(self.broker_rpc)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self.clients = []
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.broker.update()
+            time.sleep(0.05)
+
+    def spawn(self, name: str, group: str = "g", timeout: float = 4.0):
+        rpc = Rpc(name)
+        rpc.listen("127.0.0.1:0")
+        rpc.connect(self.addr)
+        g = Group(rpc, broker_name="broker", group_name=group,
+                  timeout=timeout)
+        self.clients.append((rpc, g))
+        return rpc, g
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        for rpc, g in self.clients:
+            g.close()
+            rpc.close()
+        self.broker_rpc.close()
+
+
+def _pump_accs(accs, until, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for a in accs:
+            a.update()
+        if until():
+            return
+        time.sleep(0.005)
+    raise AssertionError(
+        f"{what}: condition never reached; stats: "
+        + str([a.get_gradient_stats() for a in accs])
+    )
+
+
+def _pump_groups(groups, n, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for g in groups:
+            g.update()
+        if all(len(g.members) == n and g.active() for g in groups) and (
+            len({g.sync_id for g in groups}) == 1
+        ):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"group never stabilized at {n} members")
+
+
+def scenario_drop_storm(seed: int, calls: int = 30) -> Dict[str, int]:
+    """Seeded loss storm on both the request and the response endpoint:
+    every call completes with the right answer (poke/NACK resend +
+    cached-response replay — no lost acked call) and every request
+    executes exactly once (duplicate suppression under resend)."""
+    host = Rpc("host")
+    host.listen("127.0.0.1:0")
+    executed = []
+    lock = threading.Lock()
+
+    def work(x):
+        with lock:
+            executed.append(x)
+        return x * 3
+
+    host.define("work", work)
+    client = Rpc("client")
+    client._poke_min = 0.2
+    client.set_timeout(20.0)
+    client.connect(host.debug_info()["listen"][0])
+    plan = FaultPlan(seed).drop("work", p=0.3).drop("@success", p=0.3)
+    try:
+        with ChaosNet(plan, [client, host]):
+            futs = [client.async_("host", "work", i) for i in range(calls)]
+            for i, f in enumerate(futs):
+                got = f.result(timeout=30)
+                assert got == i * 3, f"call {i} returned {got}: lost/corrupt"
+        assert any(e.kind == "drop" for e in plan.events), (
+            "storm never dropped anything — seed too tame"
+        )
+        with lock:
+            assert sorted(executed) == list(range(calls)), (
+                f"exactly-once violated: {sorted(executed)}"
+            )
+        return plan.summary()
+    finally:
+        client.close()
+        host.close()
+
+
+def scenario_partition_heal(seed: int) -> Dict[str, int]:
+    """Partition a leaf from the tree root mid-epoch: the round must not
+    split-brain — EVERY member's future errors (none completes a partial
+    sum). After heal, the next round completes on every member."""
+    cluster = MiniCluster()
+    try:
+        peers = [cluster.spawn(f"p{i}") for i in range(3)]
+        groups = [g for _, g in peers]
+        _pump_groups(groups, 3)
+        members = groups[0].members
+        root, leaf = members[0], members[-1]
+        plan = FaultPlan(seed)
+        net = ChaosNet(plan, [rpc for rpc, _ in peers])
+        try:
+            net.partition(root, leaf)
+            futs = [g.all_reduce("parted", np.ones(2)) for g in groups]
+            deadline = time.monotonic() + 20
+            while not all(f.done() for f in futs):
+                assert time.monotonic() < deadline, (
+                    "partitioned round neither completed nor errored"
+                )
+                for g in groups:
+                    g.update()  # drives _expire_ops
+                time.sleep(0.05)
+            excs = [f.exception(timeout=1) for f in futs]
+            assert all(isinstance(e, RpcError) for e in excs), (
+                f"split outcome under partition: {excs}"
+            )
+            assert any(e.kind == "partitioned" for e in plan.events)
+
+            net.heal(root, leaf)
+            deadline = time.monotonic() + 25
+            attempt = 0
+            while True:
+                for g in groups:
+                    g.update()
+                attempt += 1
+                futs = [g.all_reduce(f"healed{attempt}", np.ones(2))
+                        for g in groups]
+                try:
+                    for f in futs:
+                        out = f.result(timeout=8)
+                        assert float(out[0]) == 3.0, out
+                    break
+                except (RpcError, TimeoutError):
+                    assert time.monotonic() < deadline, (
+                        "group never recovered after heal"
+                    )
+            return plan.summary()
+        finally:
+            net.detach_all()
+    finally:
+        cluster.close()
+
+
+def scenario_leader_loss(seed: int) -> Dict[str, int]:
+    """The elected leader freezes mid-round and then dies: stranded
+    collective futures error promptly (group timeout / epoch
+    cancellation — never the 30s RPC deadline wheel), round bookkeeping
+    does not wedge, and the survivors re-elect and reduce again —
+    including the contributions restored from the aborted epoch."""
+    from ..parallel import Accumulator
+
+    cluster = MiniCluster()
+    plan = FaultPlan(seed)
+    try:
+        accs = []
+        for i in range(3):
+            rpc, g = cluster.spawn(f"p{i}")
+            accs.append(Accumulator(rpc, group=g, virtual_batch_size=4))
+        accs[0].set_model_version(3)  # p0 wins the election (no state
+        # callbacks, so followers never inherit its version)
+        net = ChaosNet(plan, [a.rpc for a in accs])
+        _pump_accs(accs, lambda: all(
+            a.connected() and a.wants_gradients() for a in accs
+        ), 25, "initial sync")
+        assert accs[0].is_leader()
+        survivors = accs[1:]
+        for a in survivors:
+            a.reduce_gradients({"w": np.full((3,), 2.0)}, batch_size=2)
+
+        def aged():
+            # Only ops stalled >0.6s are provably waiting on the frozen
+            # leader (a live loopback round completes in milliseconds).
+            now = time.monotonic()
+            return [
+                op.future
+                for a in survivors
+                for op in list(a.group._active.values())
+                if now - op.started > 0.6 and not op.future.done()
+            ]
+
+        _pump_accs(survivors, lambda: aged(), 10, "strand a round")
+        stuck = aged()
+        assert stuck, "no in-flight collective to strand"
+        net.kill_conns(accs[0].rpc)
+        accs[0].rpc.close()
+        t0 = time.monotonic()
+        _pump_accs(survivors, lambda: all(f.done() for f in stuck), 20,
+                   "stranded futures error")
+        for f in stuck:
+            assert isinstance(f.exception(timeout=1), RpcError), (
+                "stranded future completed instead of erroring"
+            )
+        assert time.monotonic() - t0 < 20.0
+        _pump_accs(survivors, lambda: all(
+            a.connected() and len(a.group.members) == 2 for a in survivors
+        ), 25, "re-election")
+        leader = survivors[0].get_leader()
+        assert leader in ("p1", "p2") and all(
+            a.get_leader() == leader for a in survivors
+        ), "survivors disagree on the new leader"
+        _pump_accs(survivors,
+                   lambda: all(a.has_gradients() for a in survivors),
+                   25, "post-loss reduction")
+        for a in survivors:
+            mean, count = a.result_gradients()
+            assert count == 4, count
+            np.testing.assert_allclose(np.asarray(mean["w"]), 1.0)
+            assert a.get_gradient_stats()["gradient_rounds_inflight"] == 0, (
+                "gradient round left in flight after recovery"
+            )
+        return plan.summary()
+    finally:
+        cluster.close()
+
+
+SCENARIOS = {
+    "drop_storm": scenario_drop_storm,
+    "partition_heal": scenario_partition_heal,
+    "leader_loss": scenario_leader_loss,
+}

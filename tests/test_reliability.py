@@ -8,7 +8,6 @@ src/transports/ipc.cc:280-315).
 """
 
 import socket
-import struct
 import threading
 import time
 
@@ -16,7 +15,7 @@ import numpy as np
 import pytest
 
 from moolib_tpu.rpc import Rpc, RpcError
-from moolib_tpu.rpc.rpc import _BOOT_ID, FID_USER_BASE
+from moolib_tpu.rpc.rpc import _BOOT_ID
 
 
 class StallableProxy:
@@ -180,8 +179,13 @@ def test_timeout_wheel_scales_to_10k_in_flight():
 
 
 def test_poke_nack_resends_lost_request():
-    """A request silently lost in transit (written into a dying connection)
-    is recovered: the poke gets a NACK and the client resends."""
+    """A request silently lost in transit is recovered: the poke gets a
+    NACK and the client resends. Loss is injected through the chaosnet
+    seam (ISSUE 4: the old ad-hoc ``lossy_write`` monkeypatch became a
+    seeded FaultPlan, so both wire paths — fast and awaitable — are
+    covered and the scenario reproduces from its seed)."""
+    from moolib_tpu.testing.chaos import ChaosNet, FaultPlan
+
     host = Rpc("host")
     host.listen("127.0.0.1:0")
     calls = []
@@ -193,31 +197,18 @@ def test_poke_nack_resends_lost_request():
     try:
         assert client.sync("host", "inc", 1) == 2  # connection established
 
-        real_write = client._write
-        real_write_now = client._write_now
-        dropped = []
-
-        async def lossy_write(conn, frames):
-            fid = struct.unpack_from("<I", bytes(frames[0][20:24]))[0]
-            if fid >= FID_USER_BASE and not dropped:
-                dropped.append(fid)
-                return  # lose exactly one user request on the wire
-            await real_write(conn, frames)
-
-        client._write = lossy_write
-        # Disable the synchronous fast path so every send flows through the
-        # loss-injectable awaitable seam.
-        client._write_now = lambda conn, frames: False
-        t0 = time.monotonic()
-        fut = client.async_("host", "inc", 41)
-        assert fut.result(timeout=10) == 42
-        elapsed = time.monotonic() - t0
-        assert dropped, "test never exercised the loss path"
+        plan = FaultPlan(seed=41).drop("inc", count=1)
+        with ChaosNet(plan, [client, host]):
+            t0 = time.monotonic()
+            fut = client.async_("host", "inc", 41)
+            assert fut.result(timeout=10) == 42
+            elapsed = time.monotonic() - t0
+        drops = [e for e in plan.events if e.kind == "drop"]
+        assert len(drops) == 1, "plan never exercised the loss path"
+        assert drops[0].endpoint == "inc" and drops[0].me == "client"
         assert elapsed < 5.0, f"recovered only after {elapsed:.1f}s"
         assert calls == [1, 41]  # no duplicate execution
     finally:
-        client._write = real_write
-        client._write_now = real_write_now
         client.close()
         host.close()
 

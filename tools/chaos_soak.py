@@ -1,0 +1,107 @@
+"""chaosnet scenario runner: seeded fault-injection soak for the
+RPC/Group/Accumulator stack.
+
+Runs the canonical chaos scenarios (``moolib_tpu.testing.scenarios`` —
+the SAME implementations the tier-1 suite pins, so CI smoke and tests
+cannot drift) against a live in-process cluster. Two modes:
+
+- ``--smoke``: one pass over all scenarios (loss storm, partition+heal,
+  leader loss), bounded well under 60s, CPU-only — the CI stage wired
+  into tools/ci_check.sh.
+- ``--seed N --minutes M``: the long-run soak — scenarios loop with
+  seeds derived from ``N`` until the time budget is spent, so one
+  invocation covers many distinct seeded schedules. Marked slow by
+  nature; not part of tier-1.
+
+Every scenario reports the plan's injected-event summary; a failure
+prints the seed that produced it and a ready replay command, which is
+all that is needed to reproduce (see docs/reliability.md).
+
+Usage::
+
+    python tools/chaos_soak.py --smoke
+    python tools/chaos_soak.py --seed 7 --minutes 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from moolib_tpu.rpc import RpcError  # noqa: E402
+from moolib_tpu.testing.scenarios import SCENARIOS  # noqa: E402
+
+# Scenario failures surface as AssertionError (invariant violations) or,
+# when a guarantee breaks badly enough that a wait expires first, as the
+# timeout/RPC errors the drives raise. All of them must produce the
+# seed + replay line and the JSON report — never a raw traceback.
+_FAILURES = (AssertionError, RpcError, TimeoutError)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=0,
+                        help="base seed; soak iterations derive from it")
+    parser.add_argument("--minutes", type=float, default=1.0,
+                        help="soak time budget (ignored with --smoke)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="one bounded pass over all scenarios (CI)")
+    parser.add_argument("--scenario", choices=sorted(SCENARIOS),
+                        help="restrict to one scenario")
+    args = parser.parse_args(argv)
+
+    names = [args.scenario] if args.scenario else sorted(SCENARIOS)
+    runs = []
+    ok = True
+    t_start = time.monotonic()
+    deadline = (
+        None if args.smoke else t_start + args.minutes * 60.0
+    )
+    iteration = 0
+    while True:
+        for name in names:
+            seed = args.seed + 1000 * iteration + len(runs)
+            t0 = time.monotonic()
+            try:
+                summary = SCENARIOS[name](seed)
+                runs.append({
+                    "scenario": name, "seed": seed, "ok": True,
+                    "seconds": round(time.monotonic() - t0, 2),
+                    "injected": summary,
+                })
+                print(f"ok   {name} seed={seed} "
+                      f"({runs[-1]['seconds']}s) {summary}")
+            except _FAILURES as e:
+                ok = False
+                runs.append({
+                    "scenario": name, "seed": seed, "ok": False,
+                    "seconds": round(time.monotonic() - t0, 2),
+                    "error": f"{type(e).__name__}: {e}",
+                })
+                print(f"FAIL {name} seed={seed}: "
+                      f"{type(e).__name__}: {e}")
+                print(f"  replay: python tools/chaos_soak.py "
+                      f"--scenario {name} --seed {seed} --smoke")
+            if deadline is not None and time.monotonic() > deadline:
+                break
+        iteration += 1
+        if args.smoke or (deadline is not None
+                          and time.monotonic() > deadline) or not ok:
+            break
+    print(json.dumps({
+        "ok": ok,
+        "runs": len(runs),
+        "failed": [r for r in runs if not r["ok"]],
+        "total_seconds": round(time.monotonic() - t_start, 1),
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

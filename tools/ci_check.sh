@@ -28,6 +28,12 @@ python tools/moolint.py --baseline-stats --fail-nonempty
 python tools/moolint.py --baseline-stats --fail-nonempty \
   --baseline moolib_tpu/analysis/baseline_tools.json
 
+echo "== chaos smoke =="
+# Bounded seeded fault-injection pass (3 scenarios, well under 60s,
+# CPU-only): loss storm, partition+heal, leader loss. A failure prints
+# the seed + replay command (long-run version: chaos_soak.py --minutes).
+env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 rc=0

@@ -36,6 +36,12 @@ MODULES = [
      "framing"),
     ("moolib_tpu.rpc.broker", "cohort membership authority"),
     ("moolib_tpu.rpc.group", "group membership view + DCN tree allreduce"),
+    ("moolib_tpu.rpc.faults", "fault-injection hook contract for the RPC "
+     "wire seams"),
+    ("moolib_tpu.testing.chaos", "chaosnet: deterministic seeded fault "
+     "injection (FaultPlan engine + ChaosNet installer)"),
+    ("moolib_tpu.testing.scenarios", "canonical chaos scenarios shared by "
+     "the tier-1 suite and the CI soak runner"),
     ("moolib_tpu.parallel.accumulator", "elastic data-parallel gradient "
      "accumulation (ICI psum + DCN tree)"),
     ("moolib_tpu.parallel.mesh", "device mesh construction and batch "
@@ -143,7 +149,8 @@ def _index() -> str:
         "",
         "Architecture overview: [design.md](design.md). Lint rules, "
         "suppression syntax, and the baseline workflow: "
-        "[analysis.md](analysis.md).",
+        "[analysis.md](analysis.md). Fault model, delivery guarantees, "
+        "and seed replay: [reliability.md](reliability.md).",
         "",
         "Other entry points:",
         "",
@@ -154,6 +161,8 @@ def _index() -> str:
         "`tools/allreduce_decomp.py` — perf analysis tooling.",
         "- `tools/moolint.py` — static-analysis CLI; `tools/ci_check.sh` — "
         "lint + tier-1 tests, one entrypoint.",
+        "- `tools/chaos_soak.py` — chaosnet scenario runner "
+        "(`--smoke` CI stage, `--seed N --minutes M` soak).",
         "- `python -m moolib_tpu.broker` — standalone membership broker.",
         "",
     ]
