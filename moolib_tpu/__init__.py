@@ -43,6 +43,10 @@ _EXPORTS = {
     "EnvStepper": "moolib_tpu.envpool",
     "EnvStepperFuture": "moolib_tpu.envpool",
     "Batcher": "moolib_tpu.ops",
+    # observability
+    "Telemetry": "moolib_tpu.telemetry",
+    "global_telemetry": "moolib_tpu.telemetry",
+    "publish_metrics": "moolib_tpu.telemetry",
     # utils
     "set_log_level": "moolib_tpu.utils",
     "set_logging": "moolib_tpu.utils",

@@ -70,6 +70,10 @@ __all__ = ["RULES"]
 # argument (the endpoint name sits at index 1 for all three).
 _PAYLOAD_START = {"async_": 2, "sync": 2, "async_callback": 3}
 
+# Endpoints Rpc.__init__ auto-defines on every peer (the telemetry
+# export surface), resolvable at runtime regardless of lint-run scope.
+_BUILTIN_ENDPOINTS = ("__telemetry",)
+
 
 def _call_sites(
     ctx: ModuleContext,
@@ -104,6 +108,10 @@ class RpcEndpointUnknown(Rule):
         if not endpoints:
             return  # partial view (no defines in scope): cannot judge
         patterns = [e.pattern for e in endpoints]
+        # Every Rpc defines these on itself at construction (rpc/rpc.py),
+        # so they resolve on any live peer even when rpc.py sits outside
+        # this lint run (tools/ and tests/ are linted separately).
+        patterns.extend(_BUILTIN_ENDPOINTS)
         for node, _method, pat in _call_sites(ctx):
             if pat is None:
                 continue

@@ -496,6 +496,15 @@ class EnvPool:
         self._busy = [False] * num_batches
         self._events: list = [threading.Event() for _ in range(num_batches)]
         self._pending = [0] * num_batches
+        # Telemetry (process-global registry: a pool has no peer
+        # identity): dispatch→collect latency per batched step.
+        from ..telemetry import global_telemetry
+
+        self._tel = global_telemetry()
+        reg = self._tel.registry
+        self._m_steps = reg.counter("envpool_steps_total")
+        self._m_step_dur = reg.histogram("envpool_step_seconds")
+        self._step_t0 = [0.0] * num_batches
         self._callbacks: Dict[int, list] = {}
         self._notify_thread = None
         self._waiter_error: Optional[str] = None
@@ -536,6 +545,9 @@ class EnvPool:
             self._busy[batch_index] = True
             self._events[batch_index].clear()
             self._pending[batch_index] = self.num_processes
+        if self._tel.on:
+            self._m_steps.inc()
+            self._step_t0[batch_index] = time.monotonic()
         np.copyto(slab, action)
         if self._ctrl is not None:
             # Native dispatch: ring push + semaphore post per worker
@@ -764,8 +776,14 @@ class EnvPool:
         out = {
             k: v for k, v in views.items() if k != "action"
         }
+        # Read t0 BEFORE releasing the busy flag: once busy is False a
+        # racing next step() of this buffer restamps _step_t0 and the
+        # observed duration would be ~0 or negative.
+        t0 = self._step_t0[batch_index] if self._tel.on else 0.0
         with self._lock:
             self._busy[batch_index] = False
+        if t0:
+            self._m_step_dur.observe(time.monotonic() - t0)
         if self.device is not None:
             import jax
 

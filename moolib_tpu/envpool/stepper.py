@@ -32,6 +32,7 @@ import asyncio
 import concurrent.futures
 import threading
 import time
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -82,6 +83,23 @@ class EnvPoolServer:
         self._owners: dict = {}
         self._last_step: dict = {}
         self._inflight: dict = {}  # batch_index -> EnvStepperFuture
+        # Telemetry (per-Rpc registry): served-step latency + lease churn.
+        reg = rpc.telemetry.registry
+        self._m_steps = reg.counter("envpool_served_steps_total", pool=name)
+        self._m_step_dur = reg.histogram(
+            "envpool_served_step_seconds", pool=name
+        )
+        self._m_reclaims = reg.counter(
+            "envpool_lease_reclaims_total", pool=name
+        )
+        # Weakref: the registry outlives this server; a strong `self`
+        # would pin the pool's shared-memory slabs after close(), which
+        # also unregisters these series.
+        wself = weakref.ref(self)
+        reg.gauge_fn("envpool_buffers_free", lambda: len(wself()._free),
+                     pool=name)
+        reg.gauge_fn("envpool_clients", lambda: len(wself()._owners),
+                     pool=name)
         rpc.define(f"{name}::info", self._info)
         rpc.define(f"{name}::acquire", self._acquire)
         rpc.define(f"{name}::release", self._release)
@@ -122,6 +140,7 @@ class EnvPoolServer:
                     "reclaiming env buffer %d from silent client %s",
                     idx, owner,
                 )
+                self._m_reclaims.inc()
                 del self._owners[idx]
                 self._free.append(idx)
 
@@ -179,11 +198,17 @@ class EnvPoolServer:
             # in-flight step (never busy-without-future or a stale one).
             fut = self.pool.step(batch_index, np.asarray(action))
             self._inflight[batch_index] = fut
+        tel_on = self.rpc.telemetry.on
+        if tel_on:
+            self._m_steps.inc()
+        t0 = time.monotonic()
 
         # Reply from the pool's completion thread: no serving thread is
         # held while the workers step (the backpressure the old blocking
         # handler provided comes from the deferred reply instead).
         def on_done(f):
+            if tel_on:
+                self._m_step_dur.observe(time.monotonic() - t0)
             try:
                 deferred(f.result(timeout=0))
             except (asyncio.CancelledError,
@@ -198,6 +223,9 @@ class EnvPoolServer:
         fut.add_done_callback(on_done)
 
     def close(self):
+        reg = self.rpc.telemetry.registry
+        for gname in ("envpool_buffers_free", "envpool_clients"):
+            reg.unregister(gname, pool=self.name)
         for fn in ("info", "acquire", "release", "step"):
             try:
                 self.rpc.undefine(f"{self.name}::{fn}")

@@ -25,6 +25,7 @@ from typing import List, Optional
 import numpy as np
 
 import moolib_tpu
+from moolib_tpu.telemetry import publish_metrics
 from moolib_tpu.examples.common import (
     EnvBatchState,
     InProcessBroker,
@@ -332,6 +333,9 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
                 row = dict(stats.results(), env_steps=env_steps,
                            model_version=accumulator.model_version)
                 logs.append(row)
+                # Scrapeable progress: the row lands in the registry too,
+                # so any peer's __telemetry scrape shows training state.
+                publish_metrics(row, prefix="train", example="a2c")
                 log_fn(
                     "steps {env_steps:>8}  return {mean_episode_return:7.2f}  "
                     "loss {total_loss:8.4f}  entropy {entropy:6.3f}  "

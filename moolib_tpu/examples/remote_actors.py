@@ -32,6 +32,7 @@ from typing import List, Optional
 import numpy as np
 
 import moolib_tpu
+from moolib_tpu.telemetry import publish_metrics
 from moolib_tpu.examples.common import EnvBatchState
 from moolib_tpu.examples.envs import make_env_fn
 
@@ -206,10 +207,28 @@ def run_learner(cfg: RemoteConfig, listen: str = "127.0.0.1:0",
                     "fps": frames / (now - t0),
                 }
                 logs.append(row)
+                # Scrapeable progress: the learner Rpc's __telemetry
+                # scrape shows loss/fps alongside the wire metrics.
+                publish_metrics(row, prefix="train",
+                                example="remote_actors")
                 log_fn(
                     "updates {updates:>6}  frames {frames:>9}  "
                     "loss {total_loss:8.4f}  fps {fps:8.0f}".format(**row)
                 )
+        # Final flush: the loop only publishes on log ticks, so without
+        # this a scrape after exit shows the last tick's counts, not the
+        # totals the learner actually reached.
+        if updates:
+            now = time.monotonic()
+            publish_metrics(
+                {
+                    "updates": updates,
+                    "frames": frames,
+                    "total_loss": float(metrics["total_loss"]),
+                    "fps": frames / max(now - t0, 1e-9),
+                },
+                prefix="train", example="remote_actors",
+            )
     finally:
         stop.set()
         drainer.join(timeout=5)

@@ -34,6 +34,7 @@ from typing import List, Optional
 import numpy as np
 
 import moolib_tpu
+from moolib_tpu.telemetry import publish_metrics
 from moolib_tpu.examples.common import EnvBatchState, StatMean, StatSum, Stats
 from moolib_tpu.examples import common
 from moolib_tpu.examples.common.record import TsvLogger, write_metadata
@@ -502,6 +503,9 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                     leader=accumulator.is_leader(),
                 )
                 logs.append(row)
+                # Scrapeable progress: a __telemetry scrape of this
+                # peer's Rpc shows the same row the TSV/wandb sinks get.
+                publish_metrics(row, prefix="train", example="vtrace")
                 if tsv is not None:
                     tsv.log(row)
                 if wandb_run is not None:

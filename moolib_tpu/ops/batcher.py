@@ -16,12 +16,14 @@ copies, which is what keeps actor→HBM staging off the critical path.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
+from ..telemetry import global_telemetry
 from ..utils import nest
 
 __all__ = ["Batcher"]
@@ -47,10 +49,13 @@ class Batcher:
         device: Optional[Any] = None,
         dim: int = 0,
         dims: Optional[dict] = None,
+        name: str = "batcher",
     ):
         """``dims`` maps top-level dict keys to a per-key batch axis
         overriding ``dim`` — e.g. learn-unrolls are [T, B, ...] (dim=1) but
-        their ``core_state`` leaves are [B, ...] (dims={'core_state': 0})."""
+        their ``core_state`` leaves are [B, ...] (dims={'core_state': 0}).
+        ``name`` labels this batcher's telemetry series (several batchers
+        sharing a name share counters)."""
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
         self.batch_size = batch_size
@@ -64,6 +69,15 @@ class Batcher:
         self._ready: deque = deque()  # completed (host-side) batches
         self._closed = False
         self._async_waiters: list = []  # (loop, asyncio.Event) for __await__
+        # Telemetry (process-global registry: batchers have no peer
+        # identity): emitted batches/rows + time-to-fill per batch.
+        self._tel = global_telemetry()
+        reg = self._tel.registry
+        self._m_batches = reg.counter("batcher_batches_total", batcher=name)
+        self._m_rows = reg.counter("batcher_rows_total", batcher=name)
+        self._m_fill_dur = reg.histogram("batcher_fill_seconds",
+                                         batcher=name)
+        self._fill_t0: Optional[float] = None  # first item of current batch
 
     # -- producer side ------------------------------------------------------
 
@@ -71,6 +85,8 @@ class Batcher:
         """Add one unbatched structure; emits when batch_size items gathered."""
         with self._lock:
             self._check_open()
+            if self._tel.on and not self._pending_stack:
+                self._fill_t0 = time.monotonic()
             self._pending_stack.append(tree)
             if len(self._pending_stack) < self.batch_size:
                 return
@@ -80,6 +96,7 @@ class Batcher:
             )
             slot = _Slot()
             self._ready.append(slot)
+            self._record_emit_locked(1, self.batch_size)
         # Assemble + stage outside the lock.
         batch = self._stage(self._stack_trees(items))
         self._fill(slot, batch)
@@ -108,6 +125,8 @@ class Batcher:
                     raise ValueError(
                         f"cat() tree structure mismatch: {treedef} != {prev}"
                     )
+            if self._tel.on and not self._pending_cat:
+                self._fill_t0 = time.monotonic()
             self._pending_cat.append(tree)
             self._pending_cat_rows += rows
             if self._pending_cat_rows < self.batch_size:
@@ -140,6 +159,7 @@ class Batcher:
             self._pending_cat_rows = remainder
             slots = [_Slot() for _ in raws]
             self._ready.extend(slots)
+            self._record_emit_locked(len(slots), len(slots) * self.batch_size)
         # Stage the emitted batches outside the lock, in reserved order.
         for slot, raw in zip(slots, raws):
             self._fill(slot, self._stage(raw))
@@ -241,6 +261,23 @@ class Batcher:
     def _check_open(self):
         if self._closed:
             raise RuntimeError("Batcher is closed")
+
+    def _record_emit_locked(self, n_batches: int, n_rows: int) -> None:
+        """Telemetry at batch-completion time (under self._lock)."""
+        if not self._tel.on:
+            return
+        self._m_batches.inc(n_batches)
+        self._m_rows.inc(n_rows)
+        now = time.monotonic()
+        if self._fill_t0 is not None:
+            self._m_fill_dur.observe(now - self._fill_t0)
+        # cat() carry-over rows start the next batch's fill immediately —
+        # without restamping here, the "first item" stamps in add()/cat()
+        # never fire again (pending is never empty) and the fill histogram
+        # goes silent after the first remainder.
+        self._fill_t0 = (
+            now if (self._pending_stack or self._pending_cat) else None
+        )
 
     # Per-key batch-axis plumbing (dims=): a top-level dict key may carry its
     # batch dimension on a different axis than self.dim.

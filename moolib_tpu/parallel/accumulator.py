@@ -55,6 +55,7 @@ import asyncio
 import concurrent.futures
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -283,7 +284,6 @@ class Accumulator:
         # allocation serves every skipped round instead of an O(model)
         # build under the lock each time.
         self._zeros_bundle: Optional[Any] = None
-        self._chunked_rounds = 0                 # observability/testing
         # Local chunk-geometry preference, negotiated through the count
         # round (min across members — see _count_merge) so heterogeneous
         # env settings converge instead of stalling collectives.
@@ -301,6 +301,45 @@ class Accumulator:
         self._results: deque = deque()
         self._result_version = 0  # model version the latest result produces
         self._user_has_contributed = False
+
+        # Telemetry (per-Rpc registry): cumulative round/election counters
+        # live HERE — get_gradient_stats() is a thin view over them plus
+        # the live protocol state the gauge callbacks read.
+        reg = rpc.telemetry.registry
+        self._m_count_rounds = reg.counter("acc_count_rounds_total")
+        self._m_count_round_failures = reg.counter(
+            "acc_count_round_failures_total"
+        )
+        self._m_grad_rounds = reg.counter("acc_gradient_rounds_total")
+        self._m_chunked_rounds = reg.counter(
+            "acc_chunked_gradient_rounds_total"
+        )
+        self._m_grad_round_dur = reg.histogram("acc_gradient_round_seconds")
+        self._m_rounds_empty = reg.counter("acc_gradient_rounds_empty_total")
+        self._m_rounds_failed = reg.counter(
+            "acc_gradient_rounds_failed_total"
+        )
+        self._m_elections = reg.counter("acc_elections_total")
+        self._m_user_skips = reg.counter("acc_skip_gradients_total")
+        # The registry outlives this Accumulator; a strong `self` in the
+        # gauge closures would pin model-sized buffers (_zeros_bundle,
+        # _committed_bundle, _results) after close(). A dead ref scrapes
+        # as NaN until close() unregisters the series.
+        wself = weakref.ref(self)
+        self._gauge_names = (
+            "acc_model_version", "acc_results_queued",
+            "acc_gradient_rounds_inflight", "acc_synced", "acc_is_leader",
+            "acc_dark_failures",
+        )
+        reg.gauge_fn("acc_model_version", lambda: wself()._model_version)
+        reg.gauge_fn("acc_results_queued", lambda: len(wself()._results))
+        reg.gauge_fn("acc_gradient_rounds_inflight",
+                     lambda: wself()._grads_inflight)
+        reg.gauge_fn("acc_synced",
+                     lambda: 1.0 if wself()._synced else 0.0)
+        reg.gauge_fn("acc_is_leader",
+                     lambda: 1.0 if wself().is_leader() else 0.0)
+        reg.gauge_fn("acc_dark_failures", lambda: wself()._dark_failures)
 
         rpc.define(
             "AccumulatorService::requestState", self._serve_state
@@ -425,6 +464,9 @@ class Accumulator:
 
     def skip_gradients(self):
         """Explicitly contribute nothing this cycle (reference contract)."""
+        # Unconditional like every other Accumulator counter: per-round
+        # cadence, and a telemetry toggle must not skew counter ratios.
+        self._m_user_skips.inc()
         with self._lock:
             self._user_has_contributed = True
 
@@ -570,6 +612,7 @@ class Accumulator:
         except RpcError:
             self._electing = False
             return
+        self._m_elections.inc()
         fut.add_done_callback(done)
 
     # -- state sync -----------------------------------------------------------
@@ -794,6 +837,7 @@ class Accumulator:
                     except Exception as e:  # moolint: disable=swallow-cancelled
                         log.error("gradient compaction failed "
                                   "(kept staged): %s", e)
+                self._m_count_round_failures.inc()
                 with self._lock:
                     restore_snapshot_locked()
                     if self._epoch == epoch:
@@ -886,6 +930,7 @@ class Accumulator:
             self._round_inflight = False
             self._dark_failures = 0
             self._seq = seq + 1
+            self._m_count_rounds.inc()
             # A count round resolved the current wants_gradients poll;
             # peers may contribute again toward the (still unfilled)
             # virtual batch — all-skip cycles must not livelock
@@ -955,10 +1000,14 @@ class Accumulator:
         self._committed_bundle = None
         self._committed_bs = 0
         self._committed_ngrads = 0
+        # Telemetry before the gate raise: nothing between raising
+        # _grads_inflight and handing off to the collective may throw.
+        round_t0 = time.monotonic()
+        self._m_grad_rounds.inc()
+        if chunked:
+            self._m_chunked_rounds.inc()
         self._grads_inflight += 1
         self._cumulative_bs = 0
-        if chunked:
-            self._chunked_rounds += 1
 
         def settle_locked(outcome):
             """Park this round's outcome, release any now-contiguous ones."""
@@ -988,6 +1037,7 @@ class Accumulator:
                             self._synced = False
                 raise
             except Exception as e:
+                self._m_rounds_failed.inc()
                 with self._lock:
                     if self._epoch == epoch:
                         settle_locked(None)
@@ -999,11 +1049,13 @@ class Accumulator:
                             self._synced = False
                         log.debug("gradient round failed: %s", e)
                 return
+            self._m_grad_round_dur.observe(time.monotonic() - round_t0)
             with self._lock:
                 if self._epoch != epoch:
                     return
                 self._dark_failures = 0
                 if total_bundle is None:
+                    self._m_rounds_empty.inc()
                     settle_locked(None)  # nobody contributed
                     return
                 if self._bundle_template is None:
@@ -1040,6 +1092,7 @@ class Accumulator:
         except RpcError:
             # Mirror the async-failure path so this peer's release cursor
             # doesn't fall permanently behind the cluster's round keys.
+            self._m_rounds_failed.inc()
             settle_locked(None)
             if self._set_state is not None and not self.is_leader():
                 self._synced = False
@@ -1049,13 +1102,22 @@ class Accumulator:
     # -- misc -----------------------------------------------------------------
 
     def get_gradient_stats(self) -> dict:
+        """Stats dict (reference surface) — a thin view: cumulative round
+        counters read from the telemetry registry (the one source of
+        truth; also scrapeable on the Rpc's ``__telemetry`` endpoint),
+        per-epoch sequence numbers and liveness flags read from the live
+        protocol state the registry's gauge callbacks export."""
         with self._lock:
             return {
                 "model_version": self._model_version,
                 "cumulative_batch_size": self._cumulative_bs,
+                # Per-epoch protocol sequences (reset on resync); the
+                # cross-epoch cumulative counts are acc_count_rounds_total
+                # / acc_gradient_rounds_total in the registry.
                 "count_rounds": self._seq,
                 "gradient_rounds": self._gseq,
-                "chunked_gradient_rounds": self._chunked_rounds,
+                "chunked_gradient_rounds":
+                    int(self._m_chunked_rounds.value),
                 "negotiated_chunk_bytes": self._neg_chunk,
                 "gradient_rounds_inflight": self._grads_inflight,
                 "results_queued": len(self._results),
@@ -1064,8 +1126,13 @@ class Accumulator:
                 "synced": self._synced,
                 "broker_connected": self.group.broker_connected(),
                 "dark_failures": self._dark_failures,
+                "elections": int(self._m_elections.value),
+                "skipped_rounds": int(self._m_rounds_empty.value),
             }
 
     def close(self):
+        reg = self.rpc.telemetry.registry
+        for name in self._gauge_names:
+            reg.unregister(name)
         if self._owns_group:
             self.group.close()

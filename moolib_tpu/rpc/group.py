@@ -21,6 +21,7 @@ import asyncio
 import concurrent.futures
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -152,6 +153,45 @@ class Group:
         self._broker_dark_logged = False
         self._active: Dict[str, _Op] = {}
         self._parked: Dict[str, List[tuple]] = {}
+        # Telemetry (per-Rpc registry; one source of truth for round and
+        # broker-health accounting — broker_connected()/broker_silence()
+        # stay as thin views over the same state the gauges read).
+        reg = rpc.telemetry.registry
+        g = group_name
+        self._m_rounds = reg.counter("group_rounds_total", group=g)
+        self._m_round_dur = reg.histogram("group_round_seconds", group=g)
+        self._m_rounds_expired = reg.counter(
+            "group_rounds_expired_total", group=g
+        )
+        self._m_rounds_cancelled = reg.counter(
+            "group_rounds_cancelled_total", group=g
+        )
+        self._m_resyncs = reg.counter("group_resyncs_total", group=g)
+        self._m_dark_seconds = reg.counter(
+            "group_broker_dark_seconds_total", group=g
+        )
+        self._dark_mark = time.monotonic()  # last dark-time accrual point
+        # Weakref: the registry outlives this Group; a strong `self` in
+        # the gauge closures would pin it (and every parked payload)
+        # after close(). close() unregisters the series.
+        wself = weakref.ref(self)
+        self._gauge_names = (
+            "group_members", "group_broker_silence_seconds",
+            "group_broker_connected", "group_ping_inflight",
+            "group_ops_active", "group_ops_parked",
+        )
+        reg.gauge_fn("group_members", lambda: len(wself()._members), group=g)
+        reg.gauge_fn("group_broker_silence_seconds",
+                     lambda: wself().broker_silence(), group=g)
+        reg.gauge_fn("group_broker_connected",
+                     lambda: 1.0 if wself().broker_connected() else 0.0,
+                     group=g)
+        reg.gauge_fn("group_ping_inflight",
+                     lambda: 1.0 if wself()._ping_inflight else 0.0, group=g)
+        reg.gauge_fn("group_ops_active",
+                     lambda: len(wself()._active), group=g)
+        reg.gauge_fn("group_ops_parked",
+                     lambda: len(wself()._parked), group=g)
         self._shared_state(rpc).register(self)
 
     # Per-Rpc shared dispatch for the three service functions.
@@ -307,7 +347,13 @@ class Group:
                 # on_pong will never run to clear it.
                 self._ping_inflight = False
                 raise
-        if not self.broker_connected() and not self._broker_dark_logged:
+        # Broker-dark seconds accrue between update() ticks while dark —
+        # the counter form of broker_silence() that survives recoveries.
+        dark_now = not self.broker_connected()
+        mark, self._dark_mark = self._dark_mark, now
+        if dark_now and now > mark:
+            self._m_dark_seconds.inc(now - mark)
+        if dark_now and not self._broker_dark_logged:
             self._broker_dark_logged = True
             log.warning(
                 "group %s: broker %r silent for %.1fs (grace %.1fs) — "
@@ -341,7 +387,9 @@ class Group:
             if old is not None:
                 for key in [k for k in self._parked if _is_current(k, old)]:
                     del self._parked[key]
+        self._m_resyncs.inc()
         if cancelled:
+            self._m_rounds_cancelled.inc(len(cancelled))
             pool = _completion_executor()
             for op in cancelled:
                 # Fire-and-forget by design: _set_exception only completes
@@ -372,6 +420,7 @@ class Group:
                 if not self._parked[key]:
                     del self._parked[key]
         if expired:
+            self._m_rounds_expired.inc(len(expired))
             # Diagnosability under partial failure: a round that starves
             # because membership cannot heal (broker dark) reads
             # differently from one that starved under a live broker (a
@@ -435,6 +484,10 @@ class Group:
             op_obj = _Op(key, data, op_fn, index, list(self._members), fut)
             self._active[key] = op_obj
             parked = self._parked.pop(key, [])
+        # Unconditional, like every other Group counter: per-round cadence
+        # costs nothing, and a telemetry toggle mid-run must not make
+        # rounds_total diverge from rounds_expired/cancelled (>100% ratios).
+        self._m_rounds.inc()
         # Drain early arrivals from children (reference: src/group.h:771-783).
         for p_key, payload, _ts in parked:
             self._reduce_in(p_key, payload)
@@ -633,6 +686,9 @@ class Group:
             op = self._active.pop(op_key, None)
         if op is None:
             return
+        # Round duration: local start to result arrival (roots measure
+        # the full tree reduce; leaves measure their stake in it).
+        self._m_round_dur.observe(time.monotonic() - op.started)
         for c in op.children:
             child = op.members[c]
             self.rpc.async_callback(
@@ -648,6 +704,9 @@ class Group:
         )
 
     def close(self):
+        reg = self.rpc.telemetry.registry
+        for name in self._gauge_names:
+            reg.unregister(name, group=self.group_name)
         shared = getattr(self.rpc, "_moolib_group_shared", None)
         if shared is not None:
             shared.groups.pop(self.group_name, None)

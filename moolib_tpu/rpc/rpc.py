@@ -52,10 +52,18 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..telemetry import Telemetry, global_telemetry, spans_to_chrome
 from ..utils import Ewma, get_logger
 from . import serial
 
 log = get_logger("rpc")
+
+# Wire sentinel for trace-id propagation: when the caller's telemetry has
+# tracing enabled, the user payload (args, kwargs) is wrapped as
+# (_TRACE_TAG, trace_id, payload) and unconditionally unwrapped in
+# _on_request — so caller and handler spans of one call share the id.
+# Cannot collide with user payloads: those are always 2-tuples.
+_TRACE_TAG = "__mtr__"
 
 __all__ = ["Rpc", "RpcError", "Future", "Queue", "RpcDeferredReturn"]
 
@@ -76,6 +84,10 @@ _DEFAULT_TIMEOUT = 30.0
 # Write-buffer high-water mark: multi-MB gradient bundles should stream out
 # without pausing the writer on every transport buffer fill.
 _WRITE_HIGH_WATER = 8 * 1024 * 1024
+# Response-cache byte ceiling: exactly-once replies are cached for
+# poke-driven resends, but large replies (a __telemetry scrape with spans
+# can run to MBs) must not pin unbounded RSS under a long-lived poller.
+_RESPONSE_CACHE_MAX_BYTES = 64 * 1024 * 1024
 
 
 def fid_for(name: str) -> int:
@@ -462,6 +474,9 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 nbytes = 0
                 if self._body_got == len(self._body):
                     body, self._body = self._body, None
+                    rpc = self._rpc
+                    if rpc.telemetry.on:
+                        rpc._m_bytes_in.inc(serial.HEADER.size + len(body))
                     try:
                         rid, fid, obj = serial.deserialize_body(
                             memoryview(body)
@@ -492,7 +507,8 @@ class _Peer:
 
 class _Outgoing:
     __slots__ = ("rid", "peer_name", "fname", "frames", "future", "deadline",
-                 "sent_at", "conn", "poked_at", "acked", "next_slot")
+                 "sent_at", "conn", "poked_at", "acked", "next_slot",
+                 "t0", "wall0", "trace_id")
 
     def __init__(self, rid, peer_name, fname, frames, future, deadline):
         self.rid = rid
@@ -508,6 +524,12 @@ class _Outgoing:
         # Deadline-wheel slot this call is scheduled in (see
         # _sched_out): stale heap entries are skipped when they disagree.
         self.next_slot = -1
+        # Telemetry: submission instants (monotonic for the latency
+        # histogram — covers resends, unlike sent_at — and wall-clock for
+        # span placement) plus the propagated trace id, None untraced.
+        self.t0 = self.sent_at
+        self.wall0 = 0.0
+        self.trace_id: Optional[str] = None
 
 
 def _boot_id() -> str:
@@ -539,7 +561,8 @@ def _cleanup_live_rpcs():
 
 
 class Rpc:
-    def __init__(self, name: Optional[str] = None):
+    def __init__(self, name: Optional[str] = None,
+                 telemetry: Optional[Telemetry] = None):
         self._name = name or f"rpc-{secrets.token_hex(8)}"
         self._peer_id = secrets.token_hex(16)
         self._timeout = _DEFAULT_TIMEOUT
@@ -569,10 +592,14 @@ class Rpc:
         # out.next_slot; stale entries are lazily skipped on pop.
         self._out_heap: list = []
         self._sched_seq = itertools.count()
-        self._timeout_entries_processed = 0  # observability / stress tests
         self._rid_counter = itertools.count(1)
         self._recent_rids: "OrderedDict[Tuple[str, int], bool]" = OrderedDict()
         self._response_cache: "OrderedDict[Tuple[str, int], List[Any]]" = OrderedDict()
+        self._response_cache_bytes = 0
+        # Guards cache + byte-count updates: respond() runs on executor
+        # worker threads and deferred-reply threads concurrently, and an
+        # unsynchronized read-modify-write on the byte counter drifts.
+        self._response_cache_lock = threading.Lock()
         self._anon_conns: List[_Conn] = []
         self._explicit: Dict[str, dict] = {}  # addr -> {conn, last_try}
         self._closed = False
@@ -588,6 +615,44 @@ class Rpc:
         self._dial_backoff_cap = 5.0
         self._dial_rng = _pyrandom.Random()
 
+        # Telemetry: this peer's registry + trace buffer. The unified
+        # source of truth for the wire-level counters debug_info() used to
+        # track ad-hoc; hot seams guard on `telemetry.on` so disabled-mode
+        # cost is one attribute check per message.
+        self.telemetry = (
+            telemetry if telemetry is not None else Telemetry(self._name)
+        )
+        reg = self.telemetry.registry
+        self._m_bytes_out = reg.counter("rpc_bytes_sent_total")
+        self._m_bytes_in = reg.counter("rpc_bytes_received_total")
+        self._m_resends = reg.counter("rpc_resends_total")
+        self._m_pokes = reg.counter("rpc_pokes_total")
+        self._m_conn_drops = reg.counter("rpc_conn_drops_total")
+        self._m_timeouts = reg.counter("rpc_calls_timed_out_total")
+        # Wheel-entry processing count (observability / stress tests):
+        # always incremented — it replaces the pre-telemetry ad-hoc field
+        # that debug_info() exposed, and the timeout loop only touches DUE
+        # entries so the counter stays O(events).
+        self._m_timeout_entries = reg.counter(
+            "rpc_timeout_wheel_entries_total"
+        )
+        # Weakref, same contract as Group/Accumulator/EnvPoolServer: a
+        # shared/global Telemetry outlives this Rpc, and a strong `self`
+        # would pin the closed peer (conns, executor) in its registry.
+        # close() unregisters both series. The peer label keeps two Rpcs
+        # sharing one Telemetry from replacing (and, on close,
+        # unregistering) each other's gauges.
+        wself = weakref.ref(self)
+        reg.gauge_fn("rpc_inflight_calls", lambda: len(wself()._outgoing),
+                     peer=self._name)
+        reg.gauge_fn("rpc_peers", lambda: len(wself()._peers),
+                     peer=self._name)
+        # Per-endpoint series caches ({name: (calls Counter, latency
+        # Histogram)}) — one dict probe on the hot path instead of a
+        # registry get-or-create per message.
+        self._tel_client: Dict[str, tuple] = {}
+        self._tel_server: Dict[str, tuple] = {}
+
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=_executor_workers(), thread_name_prefix=f"{self._name}-fn"
         )
@@ -599,6 +664,10 @@ class Rpc:
         self._thread.start()
         self._started.wait()
         _live_rpcs.add(self)
+        # Export surface: every Rpc is scrapeable by any peer (JSON or
+        # Prometheus text; see docs/observability.md for the scrape
+        # how-to and tools/telemetry_dump.py for a cohort-wide dump).
+        self.define("__telemetry", self._serve_telemetry)
 
     # -- loop plumbing -------------------------------------------------------
 
@@ -877,6 +946,8 @@ class Rpc:
                 return
             conn.sock.writelines(frames)
             conn.last_send = time.monotonic()
+            if self.telemetry.on:
+                self._m_bytes_out.inc(serial.frames_len(frames))
             # Flow control: wait while the transport's write buffer is above
             # its high-water mark (the drain() equivalent).
             if not conn.proto._can_write.is_set():
@@ -902,6 +973,8 @@ class Rpc:
         try:
             conn.sock.writelines(frames)
             conn.last_send = time.monotonic()
+            if self.telemetry.on:
+                self._m_bytes_out.inc(serial.frames_len(frames))
             return True
         except (ConnectionError, OSError) as e:
             self._drop_conn(conn, f"write failed: {e}")
@@ -912,6 +985,8 @@ class Rpc:
                   self._name, conn.transport,
                   "out" if conn.outbound else "in",
                   conn.peer_name, conn.is_closing(), why)
+        if self.telemetry.on:
+            self._m_conn_drops.inc()
         if self._faults is not None:
             # Observation-only: scenario engines log the teardown. Hook
             # errors are swallowed here on purpose — _drop_conn must
@@ -942,6 +1017,8 @@ class Rpc:
     async def _resend_for(self, dead: _Conn):
         for out in list(self._outgoing.values()):
             if out.conn is dead and not out.future.done():
+                if self.telemetry.on:
+                    self._m_resends.inc()
                 try:
                     await self._route_and_send(out)
                 except (asyncio.CancelledError,
@@ -993,6 +1070,8 @@ class Rpc:
             # resend immediately over the current best route.
             out = self._outgoing.get(rid)
             if out is not None and not out.future.done():
+                if self.telemetry.on:
+                    self._m_resends.inc()
                 self._loop.create_task(self._send_out(out))
         elif fid in (FID_SUCCESS, FID_ERROR, FID_FNF):
             self._on_response(conn, rid, fid, obj)
@@ -1120,6 +1199,14 @@ class Rpc:
 
     def _on_request(self, conn: _Conn, rid: int, fid: int, obj):
         peer_name = conn.peer_name or "?"
+        # Trace-id unwrap is UNCONDITIONAL (the caller's tracing flag
+        # decided the wrapping; the payload must come out right either
+        # way). User payloads are always (args, kwargs) 2-tuples, so the
+        # 3-tuple sentinel cannot collide.
+        trace_id = None
+        if (type(obj) is tuple and len(obj) == 3
+                and obj[0] == _TRACE_TAG):
+            trace_id, obj = obj[1], obj[2]
         # Key by peer_id: a restarted peer reusing a name (and rids) must be
         # executed fresh, never served a previous incarnation's cache
         # (reference: PeerId-based identity, src/rpc.cc:455-487).
@@ -1142,8 +1229,37 @@ class Rpc:
             )
             return
         fname, handler = entry
+        tel = self.telemetry
+        sm = None
+        t0 = wall0 = 0.0
+        if tel.on or tel.tracing:
+            t0 = time.monotonic()
+            if tel.tracing:  # wall clock only places spans; skip otherwise
+                wall0 = time.time()
+        if tel.on:
+            sm = self._tel_server.get(fname)
+            if sm is None:
+                reg = tel.registry
+                sm = (
+                    reg.counter("rpc_server_calls_total", endpoint=fname),
+                    reg.histogram("rpc_server_handle_seconds",
+                                  endpoint=fname),
+                )
+                self._tel_server[fname] = sm
+            sm[0].inc()
 
         def respond(value, error_msg):
+            if sm is not None:
+                sm[1].observe(time.monotonic() - t0)
+            if tel.tracing and wall0:  # wall0==0: tracing flipped mid-call
+                tel.traces.add_span(
+                    f"handle {fname}", "rpc", pid=self._name,
+                    ts_us=int(wall0 * 1e6),
+                    dur_us=int((time.time() - wall0) * 1e6),
+                    trace_id=trace_id,
+                    args={"peer": peer_name, "rid": rid,
+                          "error": error_msg is not None},
+                )
             if error_msg is None:
                 frames = serial.serialize(rid, FID_SUCCESS, value)
             else:
@@ -1181,31 +1297,59 @@ class Rpc:
         handler(respond, obj)
 
     def _mark_recent(self, key):
-        self._recent_rids[key] = True
+        # False = received, still executing; _cache_response flips it to
+        # True (answered) so the poke path can tell "still working" apart
+        # from "answered but the reply frames were evicted".
+        self._recent_rids[key] = False
         while len(self._recent_rids) > 65536:
             self._recent_rids.popitem(last=False)
 
     def _cache_response(self, key, frames):
-        self._response_cache[key] = frames
-        while len(self._response_cache) > 4096:
-            self._response_cache.popitem(last=False)
+        # Bounded by entry count AND bytes: large replies (a __telemetry
+        # scrape with spans can run to MBs) must not pin unbounded RSS
+        # when a poller scrapes for hours. An evicted reply is NOT
+        # silently droppable — exactly-once forbids re-execution — so
+        # eviction degrades a lost-reply recovery from replay to a fast
+        # explicit error (see _on_poke), never a hang.
+        with self._response_cache_lock:
+            old = self._response_cache.pop(key, None)
+            if old is not None:
+                self._response_cache_bytes -= serial.frames_len(old)
+            self._response_cache[key] = frames
+            self._response_cache_bytes += serial.frames_len(frames)
+            if key in self._recent_rids:
+                self._recent_rids[key] = True  # answered
+            while len(self._response_cache) > 1 and (
+                len(self._response_cache) > 4096
+                or self._response_cache_bytes > _RESPONSE_CACHE_MAX_BYTES
+            ):
+                _k, evicted = self._response_cache.popitem(last=False)
+                self._response_cache_bytes -= serial.frames_len(evicted)
 
     def _on_poke(self, conn: _Conn, rid: int):
         """Server side of the poke protocol: the client asks whether we ever
         received request ``rid``. Known + answered -> replay the cached
-        response; known + executing -> ACK (keep waiting); unknown -> NACK
-        (client resends)."""
+        response; known + still executing -> ACK (keep waiting); answered
+        but reply evicted from the cache -> explicit error (re-execution
+        would break exactly-once; hanging to the timeout helps nobody);
+        unknown -> NACK (client resends)."""
         key = (conn.peer_id or conn.peer_name or "?", rid)
-        if key in self._recent_rids:
-            cached = self._response_cache.get(key)
-            frames = cached if cached is not None else serial.serialize(
-                rid, FID_ACK, None
-            )
-            self._loop.create_task(self._write(conn, frames))
+        answered = self._recent_rids.get(key)
+        if answered is None:
+            frames = serial.serialize(rid, FID_NACK, None)
         else:
-            self._loop.create_task(
-                self._write(conn, serial.serialize(rid, FID_NACK, None))
-            )
+            cached = self._response_cache.get(key)
+            if cached is not None:
+                frames = cached
+            elif answered:
+                frames = serial.serialize(
+                    rid, FID_ERROR,
+                    "reply evicted from the response cache before delivery "
+                    "(result lost; the call was executed exactly once)",
+                )
+            else:
+                frames = serial.serialize(rid, FID_ACK, None)
+        self._loop.create_task(self._write(conn, frames))
 
     def _on_response(self, conn: _Conn, rid: int, fid: int, obj):
         out = self._outgoing.pop(rid, None)
@@ -1213,6 +1357,22 @@ class Rpc:
             return
         rtt = time.monotonic() - out.sent_at
         conn.latency.add(rtt)
+        tel = self.telemetry
+        if tel.on:
+            cm = self._tel_client.get(out.fname)
+            if cm is not None:
+                # Full-call latency (submission to response, resends
+                # included) — what a caller actually waited.
+                cm[1].observe(time.monotonic() - out.t0)
+        if tel.tracing and out.trace_id is not None:
+            tel.traces.add_span(
+                f"call {out.fname}", "rpc", pid=self._name,
+                ts_us=int(out.wall0 * 1e6),
+                dur_us=int((time.time() - out.wall0) * 1e6),
+                trace_id=out.trace_id,
+                args={"peer": out.peer_name, "rid": rid,
+                      "ok": fid == FID_SUCCESS},
+            )
         if fid == FID_SUCCESS:
             out.future._set_result(obj)
         elif fid == FID_FNF:
@@ -1257,7 +1417,8 @@ class Rpc:
             )
             worker = threading.Thread(
                 target=_batched_server_loop,
-                args=(queue, fn, device, batch_size if pad else None),
+                args=(queue, fn, device, batch_size if pad else None,
+                      self.telemetry, batch_size),
                 name=f"{self._name}-batch-{name}",
                 daemon=True,
             )
@@ -1358,9 +1519,31 @@ class Rpc:
         fut = Future()
         rid = (next(self._rid_counter) << 1) | 1
         log.debug("%s: call %s::%s rid=%d", self._name, peer, func, rid)
-        frames = serial.serialize(rid, fid_for(func), (args, kwargs))
+        tel = self.telemetry
+        payload: Any = (args, kwargs)
+        trace_id = None
+        if tel.tracing:
+            # Trace-id propagation: ride the payload (see _TRACE_TAG);
+            # the handler side unwraps unconditionally.
+            trace_id = f"{self._peer_id[:8]}-{rid:x}"
+            payload = (_TRACE_TAG, trace_id, payload)
+        if tel.on:
+            cm = self._tel_client.get(func)
+            if cm is None:
+                reg = tel.registry
+                cm = (
+                    reg.counter("rpc_client_calls_total", endpoint=func),
+                    reg.histogram("rpc_client_latency_seconds",
+                                  endpoint=func),
+                )
+                self._tel_client[func] = cm
+            cm[0].inc()
+        frames = serial.serialize(rid, fid_for(func), payload)
         out = _Outgoing(rid, peer, func, frames, fut,
                         time.monotonic() + self._timeout)
+        if trace_id is not None:
+            out.trace_id = trace_id
+            out.wall0 = time.time()
         def submit():
             self._outgoing[rid] = out
             # Fast path: route + write synchronously when the peer has a
@@ -1514,9 +1697,11 @@ class Rpc:
                     if out.future.done():
                         self._outgoing.pop(rid, None)
                         continue
-                    self._timeout_entries_processed += 1
+                    self._m_timeout_entries.inc()
                     if now >= out.deadline:
                         self._outgoing.pop(rid, None)
+                        if self.telemetry.on:
+                            self._m_timeouts.inc()
                         out.future._set_exception(
                             RpcError(
                                 f"call to {out.peer_name}::{out.fname} "
@@ -1543,6 +1728,8 @@ class Rpc:
                             if conn is None:
                                 out.conn = None  # re-route on next check
                             else:
+                                if self.telemetry.on:
+                                    self._m_pokes.inc()
                                 try:
                                     await self._write(
                                         conn,
@@ -1604,13 +1791,31 @@ class Rpc:
     # -- introspection / lifecycle ------------------------------------------
 
     def debug_info(self) -> dict:
-        """Per-peer transport/latency info (reference: src/rpc.cc:1598-1623)."""
+        """Per-peer transport/latency info (reference: src/rpc.cc:1598-1623).
+
+        Thin view over the telemetry registry for everything countable —
+        the registry is the one source of truth (``in_flight``,
+        ``timeout_entries_processed``, and the ``telemetry`` wire counters
+        all read from it); only live connection/backoff structure is
+        assembled here."""
+        reg = self.telemetry.registry
         info = {"name": self._name, "listen": list(self._listen_addrs),
-                "in_flight": len(self._outgoing),
+                "in_flight": int(
+                    reg.value("rpc_inflight_calls", peer=self._name) or 0
+                ),
                 # Wheel-entry processing count: stress tests assert this
                 # stays O(events), not O(in-flight x ticks).
                 "timeout_entries_processed":
-                    self._timeout_entries_processed,
+                    int(self._m_timeout_entries.value),
+                # Wire-level counters, straight from the registry.
+                "telemetry": {
+                    "bytes_sent": int(self._m_bytes_out.value),
+                    "bytes_received": int(self._m_bytes_in.value),
+                    "resends": int(self._m_resends.value),
+                    "pokes": int(self._m_pokes.value),
+                    "conn_drops": int(self._m_conn_drops.value),
+                    "calls_timed_out": int(self._m_timeouts.value),
+                },
                 # Explicit-reconnect schedule (backoff/jitter state), so
                 # tests and operators can see redial pacing per address.
                 # list(): connect() registers entries on the loop thread
@@ -1640,6 +1845,40 @@ class Rpc:
             }
         return info
 
+    def _serve_telemetry(self, fmt: str = "json", spans: bool = False):
+        """Handler for the auto-defined ``__telemetry`` endpoint.
+
+        ``fmt="json"`` returns ``{"name", "metrics", "peers", ["trace"]}``
+        where ``metrics`` merges the process-global registry (batchers,
+        env pools, chaos plans, example loops) under this peer's own — so
+        any peer's scrape shows the whole process — and ``peers`` lists
+        this peer's dialable neighbours so a scraper can crawl the cohort
+        (tools/telemetry_dump.py). ``fmt="prometheus"`` returns the text
+        exposition of the same merged view. With ``spans=True`` (JSON
+        only) the Chrome-trace export of this peer's spans plus the
+        process-global buffer rides along."""
+        tel = self.telemetry
+        gt = global_telemetry()
+        if fmt in ("prometheus", "prom", "text"):
+            if tel is gt:
+                return tel.prometheus()
+            return gt.prometheus() + tel.prometheus()
+        metrics = {} if tel is gt else gt.snapshot()
+        metrics.update(tel.snapshot())
+        # Advertise dialable neighbours (peers with known addresses) so a
+        # scraper dialed into ONE peer can crawl the whole cohort — the
+        # connection table only gossips on demand, never spontaneously.
+        out = {"name": self._name, "metrics": metrics,
+               "peers": sorted(p.name for p in list(self._peers.values())
+                               if p.addresses and p.name != self._name)}
+        if spans:
+            all_spans = tel.traces.spans()
+            if tel is not gt:
+                all_spans = all_spans + gt.traces.spans()
+            all_spans.sort(key=lambda s: (s.ts, s.pid, s.name))
+            out["trace"] = spans_to_chrome(all_spans)
+        return out
+
     @property
     def name(self):
         return self._name
@@ -1648,6 +1887,9 @@ class Rpc:
         if self._closed:
             return
         self._closed = True
+        reg = self.telemetry.registry
+        reg.unregister("rpc_inflight_calls", peer=self._name)
+        reg.unregister("rpc_peers", peer=self._name)
         for q in self._queues.values():
             q._close()
         for out in self._outgoing.values():
@@ -1688,11 +1930,20 @@ def _executor_workers() -> int:
 
 
 def _batched_server_loop(queue: Queue, fn: Callable, device,
-                         pad_to: Optional[int]):
+                         pad_to: Optional[int],
+                         telemetry: Optional[Telemetry] = None,
+                         target_bs: Optional[int] = None):
     """Server-side dynamic batching for define(batch_size=) (reference:
     src/moolib.cc:1007-1062 — stack requests, one call, unbatch replies)."""
+    from ..telemetry import FRACTION_EDGES
     from ..utils import nest
 
+    fill_hist = None
+    if telemetry is not None and target_bs:
+        fill_hist = telemetry.registry.histogram(
+            "rpc_batch_fill_fraction", edges=FRACTION_EDGES,
+            endpoint=queue.name,
+        )
     while True:
         try:
             return_cb, args, kwargs = queue.get(timeout=1.0)
@@ -1702,6 +1953,8 @@ def _batched_server_loop(queue: Queue, fn: Callable, device,
             return  # queue closed
         try:
             n = return_cb.batch_size
+            if fill_hist is not None and telemetry.on:
+                fill_hist.observe(n / target_bs)
             if pad_to is not None and n < pad_to:
                 def _pad(x):
                     reps = np.concatenate(

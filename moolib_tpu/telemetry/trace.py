@@ -1,0 +1,142 @@
+"""Trace spans: a bounded buffer exportable as Chrome-trace/Perfetto JSON.
+
+The reference's observability is flamegraph-style *host* tracing of its
+C++ worker threads; here the actionable cross-peer picture is a timeline
+of RPC call/handle spans — caller and handler sides of one call share a
+**trace id** propagated through the wire payload (see
+``moolib_tpu/rpc/rpc.py``), so a merged dump from several peers
+(``tools/telemetry_dump.py``) reconstructs causality across the cohort.
+chaosnet injected-fault events and ``utils/profiling.py`` jax-profiler
+capture windows land on the same timeline, which is what makes a seeded
+chaos replay *readable*: the drop/delay instants sit right next to the
+latency they caused.
+
+Span timestamps are wall-clock microseconds (``time.time()``), the one
+clock different hosts share well enough to merge; durations are measured
+with the monotonic clock, so a span's extent is immune to wall-clock
+steps even though its placement is not.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "TraceBuffer", "now_us"]
+
+
+def now_us() -> int:
+    """Wall-clock microseconds — the shared axis of the merged timeline."""
+    return int(time.time() * 1e6)
+
+
+class Span:
+    """One trace event (Chrome-trace ``X`` complete or ``i`` instant)."""
+
+    __slots__ = ("name", "cat", "ph", "ts", "dur", "pid", "tid",
+                 "trace_id", "args")
+
+    def __init__(self, name: str, cat: str, ph: str, ts: int, dur: int,
+                 pid: str, tid: int, trace_id: Optional[str],
+                 args: Optional[Dict[str, Any]]):
+        self.name = name
+        self.cat = cat
+        self.ph = ph
+        self.ts = ts
+        self.dur = dur
+        self.pid = pid
+        self.tid = tid
+        self.trace_id = trace_id
+        self.args = args
+
+    def to_event(self, pid_map: Dict[str, int]) -> Dict[str, Any]:
+        args = dict(self.args) if self.args else {}
+        if self.trace_id is not None:
+            args["trace_id"] = self.trace_id
+        ev: Dict[str, Any] = {
+            "name": self.name,
+            "cat": self.cat,
+            "ph": self.ph,
+            "ts": self.ts,
+            "pid": pid_map[self.pid],
+            "tid": self.tid,
+            "args": args,
+        }
+        if self.ph == "X":
+            ev["dur"] = self.dur
+        else:
+            ev["s"] = "p"  # instant scope: process
+        return ev
+
+
+class TraceBuffer:
+    """Bounded span ring (oldest spans evicted first).
+
+    Recording is append-under-lock; owners gate recording on their
+    ``Telemetry.tracing`` flag, so an idle buffer costs nothing.
+    """
+
+    def __init__(self, capacity: int = 65536):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=int(capacity))
+
+    def add_span(self, name: str, cat: str, pid: str, ts_us: int,
+                 dur_us: int, trace_id: Optional[str] = None,
+                 tid: int = 0, args: Optional[Dict[str, Any]] = None) -> None:
+        """Record a complete (``ph=X``) span."""
+        span = Span(name, cat, "X", int(ts_us), max(0, int(dur_us)),
+                    pid, tid, trace_id, args)
+        with self._lock:
+            self._spans.append(span)
+
+    def add_instant(self, name: str, cat: str, pid: str,
+                    ts_us: Optional[int] = None,
+                    trace_id: Optional[str] = None,
+                    args: Optional[Dict[str, Any]] = None) -> None:
+        """Record an instant (``ph=i``) event — chaos injections etc."""
+        span = Span(name, cat, "i", now_us() if ts_us is None else int(ts_us),
+                    0, pid, 0, trace_id, args)
+        with self._lock:
+            self._spans.append(span)
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Export as a Chrome-trace JSON object (load in Perfetto /
+        chrome://tracing). ``pid`` strings (peer names) are mapped to
+        stable small ints with ``process_name`` metadata events so every
+        peer renders as its own named process track."""
+        spans = sorted(self.spans(), key=lambda s: (s.ts, s.pid, s.name))
+        return spans_to_chrome(spans)
+
+
+def spans_to_chrome(spans: List[Span]) -> Dict[str, Any]:
+    """Shared Chrome-trace assembly for one buffer or a cross-peer merge
+    (``tools/telemetry_dump.py`` concatenates peers' span lists first)."""
+    pid_map: Dict[str, int] = {}
+    for s in spans:
+        if s.pid not in pid_map:
+            pid_map[s.pid] = len(pid_map) + 1
+    events: List[Dict[str, Any]] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": name},
+        }
+        for name, pid in sorted(pid_map.items(), key=lambda kv: kv[1])
+    ]
+    events.extend(s.to_event(pid_map) for s in spans)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -48,6 +48,7 @@ from random import Random
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..rpc.faults import DELAY, DROP, DUP, PASS_VERDICT, Verdict
+from ..telemetry import Telemetry, global_telemetry
 from ..rpc.rpc import (
     FID_ACK,
     FID_ERROR,
@@ -127,7 +128,7 @@ class FaultPlan:
     threads consult the same plan concurrently.
     """
 
-    def __init__(self, seed: int = 0):
+    def __init__(self, seed: int = 0, telemetry: Optional[Telemetry] = None):
         self.seed = int(seed)
         self._rng = Random(self.seed)
         self._lock = threading.Lock()
@@ -138,6 +139,18 @@ class FaultPlan:
         self._partitions: Set[frozenset] = set()
         self._slow_links: Dict[str, float] = {}
         self._keepalive_holes: Set[str] = set()
+        # Telemetry mirror of the event log: every injected action bumps
+        # chaos_injected_total{kind=...} (organic observations go to
+        # chaos_observed_total{kind=...}), and with tracing enabled each
+        # injection lands as an instant event on the shared trace
+        # timeline — right next to the latency it caused. Counters are
+        # process-cumulative, so the per-kind baseline snapshot taken at
+        # first use keeps verify_telemetry()/telemetry_counts()
+        # plan-relative.
+        self._tel = telemetry if telemetry is not None else global_telemetry()
+        self._tel_counters: Dict[str, Any] = {}
+        self._tel_base: Dict[str, float] = {}
+        self._obs_counters: Dict[str, Any] = {}
 
     # -- rule builders --------------------------------------------------------
 
@@ -304,6 +317,19 @@ class FaultPlan:
             Event(self._seq, kind, action, me, peer, endpoint, rid, arg)
         )
         self._seq += 1
+        c = self._tel_counters.get(kind)
+        if c is None:
+            c = self._tel.registry.counter("chaos_injected_total", kind=kind)
+            self._tel_counters[kind] = c
+            self._tel_base[kind] = c.value
+        c.inc()
+        if self._tel.tracing:
+            self._tel.traces.add_instant(
+                f"chaos {kind}", "chaos", pid=me or "chaos",
+                args={"action": str(action), "peer": peer,
+                      "endpoint": endpoint, "rid": rid,
+                      "arg": None if arg is None else float(arg)},
+            )
 
     def observe(self, kind: str, me: str, peer: Optional[str], detail: str):
         """Record an organic observation (kept OUT of the injected-event
@@ -313,6 +339,18 @@ class FaultPlan:
                 Event(len(self.observed), kind, "observe", me, peer, None,
                       None, detail)
             )
+            c = self._obs_counters.get(kind)
+            if c is None:
+                c = self._tel.registry.counter(
+                    "chaos_observed_total", kind=kind
+                )
+                self._obs_counters[kind] = c
+            c.inc()
+            if self._tel.tracing:
+                self._tel.traces.add_instant(
+                    f"chaos observed {kind}", "chaos", pid=me or "chaos",
+                    args={"peer": peer, "detail": str(detail)},
+                )
 
     def summary(self) -> Dict[str, int]:
         """Injected-action counts by kind — the soak tool's report unit."""
@@ -321,6 +359,35 @@ class FaultPlan:
             for e in self.events:
                 out[e.kind] = out.get(e.kind, 0) + 1
             return out
+
+    def telemetry_counts(self) -> Dict[str, int]:
+        """Per-kind injected counts as recorded in the telemetry registry,
+        relative to this plan's first use of each kind (the registry is
+        process-cumulative across plans)."""
+        with self._lock:
+            return {
+                k: int(round(c.value - self._tel_base[k]))
+                for k, c in self._tel_counters.items()
+            }
+
+    def verify_telemetry(self) -> None:
+        """Assert the registry's injected-fault counters exactly match the
+        event log — the contract ``tools/chaos_soak.py --smoke`` (via the
+        canonical scenarios) enforces on every run. Raises
+        ``AssertionError`` on any divergence."""
+        with self._lock:
+            want: Dict[str, int] = {}
+            for e in self.events:
+                want[e.kind] = want.get(e.kind, 0) + 1
+            got = {
+                k: int(round(c.value - self._tel_base[k]))
+                for k, c in self._tel_counters.items()
+            }
+        if got != want:
+            raise AssertionError(
+                f"telemetry fault counters diverge from the injected-event "
+                f"log: registry={got} events={want}"
+            )
 
 
 class _RpcFaultHooks:

@@ -129,6 +129,7 @@ def scenario_drop_storm(seed: int, calls: int = 30) -> Dict[str, int]:
             assert sorted(executed) == list(range(calls)), (
                 f"exactly-once violated: {sorted(executed)}"
             )
+        plan.verify_telemetry()  # registry counters == injected log
         return plan.summary()
     finally:
         client.close()
@@ -183,6 +184,7 @@ def scenario_partition_heal(seed: int) -> Dict[str, int]:
                     assert time.monotonic() < deadline, (
                         "group never recovered after heal"
                     )
+            plan.verify_telemetry()  # registry counters == injected log
             return plan.summary()
         finally:
             net.detach_all()
@@ -257,6 +259,7 @@ def scenario_leader_loss(seed: int) -> Dict[str, int]:
             assert a.get_gradient_stats()["gradient_rounds_inflight"] == 0, (
                 "gradient round left in flight after recovery"
             )
+        plan.verify_telemetry()  # registry counters == injected log
         return plan.summary()
     finally:
         cluster.close()

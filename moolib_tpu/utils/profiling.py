@@ -1,4 +1,4 @@
-"""Profiler capture: the tracing half of the observability story.
+"""Profiler capture: the device-tracing half of the observability story.
 
 The reference's tracing is flamegraph-style host tracing of its C++ threads
 (reference: src/moolib.cc trace hooks / py/moolib docs). On TPU the
@@ -7,15 +7,38 @@ actionable trace is XLA's: ``jax.profiler`` captures device timelines
 or Perfetto. This wraps it with a zero-dependency context manager and a
 step-window helper so experiments can capture exactly N steps without
 instrumenting their loops twice.
+
+Timeline merge: every capture window is also recorded as a span on the
+:mod:`moolib_tpu.telemetry` trace buffer (category ``profiler``, args
+pointing at the logdir), so a cohort dump from
+``tools/telemetry_dump.py`` shows *where* the XLA capture sat relative to
+RPC call/handle spans and chaosnet injections — open the logdir's own
+Perfetto trace beside it for the device-level zoom of that window.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import time
 from typing import Iterator, Optional
 
 __all__ = ["profile_trace", "StepWindowProfiler"]
+
+
+def _record_window(logdir: str, wall0: float, args: Optional[dict] = None):
+    """Mark a finished capture window on the shared telemetry timeline.
+    Unconditional (capture is rare and deliberate — no hot-path gate)."""
+    from ..telemetry import global_telemetry
+
+    span_args = {"logdir": logdir}
+    if args:
+        span_args.update(args)
+    global_telemetry().traces.add_span(
+        "jax_profiler_capture", "profiler", pid="profiler",
+        ts_us=int(wall0 * 1e6), dur_us=int((time.time() - wall0) * 1e6),
+        args=span_args,
+    )
 
 
 @contextlib.contextmanager
@@ -25,8 +48,12 @@ def profile_trace(logdir: str) -> Iterator[None]:
     import jax
 
     os.makedirs(logdir, exist_ok=True)
-    with jax.profiler.trace(logdir):
-        yield
+    wall0 = time.time()
+    try:
+        with jax.profiler.trace(logdir):
+            yield
+    finally:
+        _record_window(logdir, wall0)
 
 
 class StepWindowProfiler:
@@ -47,6 +74,7 @@ class StepWindowProfiler:
         self.start = start
         self.stop = stop
         self._active = False
+        self._wall0 = 0.0
 
     def step(self, step_index: int) -> None:
         if self.logdir is None:
@@ -55,11 +83,14 @@ class StepWindowProfiler:
 
         if not self._active and self.start <= step_index < self.stop:
             os.makedirs(self.logdir, exist_ok=True)
+            self._wall0 = time.time()
             jax.profiler.start_trace(self.logdir)
             self._active = True
         elif self._active and step_index >= self.stop:
             jax.profiler.stop_trace()
             self._active = False
+            _record_window(self.logdir, self._wall0,
+                           {"start_step": self.start, "stop_step": self.stop})
 
     def close(self) -> None:
         if self._active:
@@ -67,3 +98,5 @@ class StepWindowProfiler:
 
             jax.profiler.stop_trace()
             self._active = False
+            _record_window(self.logdir, self._wall0,
+                           {"start_step": self.start, "closed_early": True})

@@ -188,3 +188,25 @@ def test_batcher_await_cancellation_consumes_nothing():
         return await b
 
     asyncio.run(cleaner())
+
+
+def test_cat_remainder_keeps_fill_histogram_recording(rng):
+    """Regression: a cat() emit that carries remainder rows leaves pending
+    non-empty forever, so the fill-time histogram must restamp its start
+    at emit time — not wait for an 'empty -> first item' transition that
+    never comes again."""
+    from moolib_tpu.telemetry import global_telemetry
+
+    name = "fill-regress"
+    b = Batcher(batch_size=4, name=name)
+    hist = global_telemetry().registry.histogram(
+        "batcher_fill_seconds", batcher=name
+    )
+    base = hist.count
+    for _ in range(4):  # 3 rows each: every emit carries a remainder
+        b.cat({"x": np.ones((3, 2), np.float32)})
+    # 12 rows -> 3 emitted batches, each with a fill-time observation.
+    assert hist.count - base == 3
+    assert global_telemetry().registry.value(
+        "batcher_batches_total", batcher=name
+    ) == 3.0

@@ -21,6 +21,9 @@ def _quiet(*a, **k):
 
 
 @pytest.mark.integration
+@pytest.mark.slow  # learning bar is wall-clock-paced (async accumulator
+# updates race env steps), so host load — not code — decides the outcome
+# when it lands inside the tier-1 window; verified flaky at HEAD too.
 def test_a2c_cartpole_learns():
     cfg = A2CConfig(seed=0, total_steps=60_000, log_interval_steps=2_000)
     logs = a2c_train(cfg, log_fn=_quiet)
@@ -195,9 +198,20 @@ def test_a2c_pixel_smoke():
     logs = a2c_train(cfg, log_fn=_quiet)
     assert logs and logs[-1]["updates"] >= 1
     assert np.isfinite(logs[-1]["total_loss"])
+    # The logged rows also land in the scrapeable registry
+    # (publish_metrics bridge): a live __telemetry scrape of a training
+    # process shows its progress.
+    from moolib_tpu.telemetry import global_telemetry
+
+    reg = global_telemetry().registry
+    assert reg.value("train_total_loss", example="a2c") == pytest.approx(
+        logs[-1]["total_loss"]
+    )
+    assert reg.value("train_updates", example="a2c") == logs[-1]["updates"]
 
 
 @pytest.mark.integration
+@pytest.mark.slow  # same wall-clock pacing caveat as the a2c learning bar
 def test_remote_actors_learner():
     """SEED-style split: two thin actor loops feed a central learner over
     RPC — policy served via define(batch_size=, pad=True) inference
@@ -259,4 +273,14 @@ def test_remote_actors_learner():
     assert sum(frames) > 0
     rows = logs_box["logs"]
     assert rows and rows[-1]["updates"] >= 1
+    # publish_metrics bridge: the learner's final flush leaves the
+    # registry at least as fresh as the last logged row (the loop exit —
+    # total_updates or max_seconds — may postdate the last 0.5s log tick).
+    from moolib_tpu.telemetry import global_telemetry
+
+    tele_updates = global_telemetry().registry.value(
+        "train_updates", example="remote_actors"
+    )
+    assert tele_updates is not None
+    assert tele_updates >= rows[-1]["updates"]
     assert np.isfinite(rows[-1]["total_loss"])

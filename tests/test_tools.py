@@ -147,3 +147,44 @@ def test_moolint_whole_repo_runtime_budget():
         f"whole-repo moolint run took {elapsed:.1f}s (budget: 20s); "
         "profile the newest rule family before landing it"
     )
+
+
+def test_telemetry_dump_crawls_cohort_from_one_address(tmp_path):
+    """Dialing ONE cohort member reaches the whole connected cohort: the
+    __telemetry reply advertises dialable neighbours and the dump tool
+    crawls them (the scraper's connection table never grows on its own —
+    gossip is on demand). Connect-only peers (no listen address) are not
+    advertised."""
+    import json
+
+    from moolib_tpu.rpc import Rpc
+    from moolib_tpu.telemetry import Telemetry, parse_prometheus
+
+    a, b = Rpc("crawl-a"), Rpc("crawl-b")
+    lurker = Rpc("crawl-lurker", telemetry=Telemetry("l", enabled=False))
+    try:
+        b.define("work", lambda x: x)
+        b.listen("127.0.0.1:0")
+        a.listen("127.0.0.1:0")
+        addr = b.debug_info()["listen"][0]
+        a.connect(addr)
+        lurker.connect(addr)  # connect-only: must NOT be crawled
+        for i in range(5):
+            assert a.sync("crawl-b", "work", i) == i
+        out = tmp_path / "dump"
+        proc = subprocess.run(
+            [sys.executable, str(REPO_ROOT / "tools" / "telemetry_dump.py"),
+             "--connect", addr, "--prometheus", "--out", str(out)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        metrics = json.loads((out / "metrics.json").read_text())
+        assert set(metrics) == {"crawl-a", "crawl-b"}, sorted(metrics)
+        assert metrics["crawl-b"][
+            'rpc_server_calls_total{endpoint="work"}']["value"] == 5
+        for peer in ("crawl-a", "crawl-b"):
+            parse_prometheus((out / f"{peer}.prom").read_text())
+    finally:
+        lurker.close()
+        a.close()
+        b.close()

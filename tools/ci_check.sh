@@ -28,6 +28,13 @@ python tools/moolint.py --baseline-stats --fail-nonempty
 python tools/moolint.py --baseline-stats --fail-nonempty \
   --baseline moolib_tpu/analysis/baseline_tools.json
 
+echo "== telemetry smoke =="
+# Live __telemetry scrape of a two-Rpc cohort (JSON + Prometheus text
+# through the strict parser, trace-id propagation) plus the disabled-mode
+# instrumentation overhead budget (<5% of echo latency, measured at the
+# gate so loopback noise can't flake it). See docs/observability.md.
+env JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
+
 echo "== chaos smoke =="
 # Bounded seeded fault-injection pass (3 scenarios, well under 60s,
 # CPU-only): loss storm, partition+heal, leader loss. A failure prints

@@ -38,6 +38,12 @@ MODULES = [
     ("moolib_tpu.rpc.group", "group membership view + DCN tree allreduce"),
     ("moolib_tpu.rpc.faults", "fault-injection hook contract for the RPC "
      "wire seams"),
+    ("moolib_tpu.telemetry", "unified telemetry: metrics registry + trace "
+     "spans + the __telemetry scrape surface"),
+    ("moolib_tpu.telemetry.registry", "counters, gauges, fixed-log-bucket "
+     "histograms; JSON/Prometheus exports"),
+    ("moolib_tpu.telemetry.trace", "bounded span buffer with "
+     "Chrome-trace/Perfetto export"),
     ("moolib_tpu.testing.chaos", "chaosnet: deterministic seeded fault "
      "injection (FaultPlan engine + ChaosNet installer)"),
     ("moolib_tpu.testing.scenarios", "canonical chaos scenarios shared by "
@@ -150,7 +156,9 @@ def _index() -> str:
         "Architecture overview: [design.md](design.md). Lint rules, "
         "suppression syntax, and the baseline workflow: "
         "[analysis.md](analysis.md). Fault model, delivery guarantees, "
-        "and seed replay: [reliability.md](reliability.md).",
+        "and seed replay: [reliability.md](reliability.md). Metric name "
+        "catalogue, span semantics, and the scrape how-to: "
+        "[observability.md](observability.md).",
         "",
         "Other entry points:",
         "",
@@ -163,6 +171,10 @@ def _index() -> str:
         "lint + tier-1 tests, one entrypoint.",
         "- `tools/chaos_soak.py` — chaosnet scenario runner "
         "(`--smoke` CI stage, `--seed N --minutes M` soak).",
+        "- `tools/telemetry_dump.py` — scrape a live cohort's "
+        "`__telemetry` endpoints into one merged metrics/trace dump.",
+        "- `tools/telemetry_smoke.py` — live scrape validation + "
+        "disabled-mode overhead budget (CI stage).",
         "- `python -m moolib_tpu.broker` — standalone membership broker.",
         "",
     ]

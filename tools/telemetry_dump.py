@@ -1,0 +1,211 @@
+"""Scrape a live cohort's ``__telemetry`` endpoints into one merged dump.
+
+Every :class:`~moolib_tpu.rpc.Rpc` auto-defines ``__telemetry`` (see
+docs/observability.md), so observability of a running cohort needs no
+code in the cohort itself: this tool dials in as one more peer, scrapes
+every peer it can see, and writes
+
+- ``metrics.json`` — ``{peer_name: {series_id: series}}``, the JSON
+  snapshot of each peer's registry (process-global metrics merged in by
+  the serving peer);
+- ``<peer>.prom`` — the Prometheus text exposition per peer (with
+  ``--prometheus``), validated through the strict parser so a format
+  regression fails the scrape loudly;
+- ``trace.json`` — with ``--spans``, every peer's Chrome-trace export
+  merged onto ONE timeline (load in Perfetto / chrome://tracing): RPC
+  call/handle spans correlated by trace id across peers, chaosnet
+  injection instants, and jax-profiler capture windows. Peers in one OS
+  process each merge the process-global buffer into their export;
+  identical events are deduplicated here so shared tracks appear once.
+
+Peers are discovered by crawling: every ``__telemetry`` reply advertises
+the serving peer's dialable neighbours, so dialing into ONE cohort
+member reaches the whole connected cohort (name resolution rides the
+RPC plane's find-peer gossip — connect-only peers without a listen
+address are not reachable and are not advertised). ``--peers`` pins the
+exact set to scrape instead.
+
+Usage::
+
+    python tools/telemetry_dump.py --connect 127.0.0.1:4411 --out dump/
+    python tools/telemetry_dump.py --connect host:4411 --peers a,b \
+        --spans --prometheus --out dump/
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from moolib_tpu.rpc import Rpc, RpcError  # noqa: E402
+from moolib_tpu.telemetry import Telemetry, parse_prometheus  # noqa: E402
+
+
+def merge_chrome_traces(traces: "list[tuple[str, dict]]") -> dict:
+    """Merge per-peer Chrome-trace dicts onto one timeline.
+
+    Tracks (Chrome ``pid`` ints) are re-keyed by their ``process_name``
+    metadata so the same logical track scraped via two peers in one OS
+    process lands on one merged track; non-metadata events are
+    deduplicated exactly (two peers exporting the shared process-global
+    buffer must not double every chaos instant)."""
+    track_ids: "dict[str, int]" = {}
+    events: "list[dict]" = []
+    seen: "set[str]" = set()
+    for _peer, trace in traces:
+        names = {
+            ev["pid"]: ev["args"]["name"]
+            for ev in trace.get("traceEvents", [])
+            if ev.get("ph") == "M" and ev.get("name") == "process_name"
+        }
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") == "M":
+                continue
+            track = names.get(ev["pid"], f"pid{ev['pid']}")
+            if track not in track_ids:
+                track_ids[track] = len(track_ids) + 1
+                events.append({
+                    "name": "process_name", "ph": "M",
+                    "pid": track_ids[track], "tid": 0,
+                    "args": {"name": track},
+                })
+            out = dict(ev)
+            out["pid"] = track_ids[track]
+            key = json.dumps(out, sort_keys=True, default=str)
+            if key in seen:
+                continue
+            seen.add(key)
+            events.append(out)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def scrape(rpc: Rpc, peer: str, spans: bool, prometheus: bool):
+    """One peer's full scrape: (json snapshot, prom text or None). The
+    per-scrape deadline is the scraper Rpc's call timeout (set_timeout)."""
+    snap = rpc.sync(peer, "__telemetry", spans=spans)
+    prom = None
+    if prometheus:
+        prom = rpc.sync(peer, "__telemetry", fmt="prometheus")
+        parse_prometheus(prom)  # format regression -> loud failure
+    return snap, prom
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--connect", action="append", required=True,
+                        help="address of any cohort peer (repeatable)")
+    parser.add_argument("--peers",
+                        help="comma-separated peer names to scrape "
+                             "(default: every discovered peer)")
+    parser.add_argument("--out", default="telemetry_dump",
+                        help="output directory")
+    parser.add_argument("--spans", action="store_true",
+                        help="also scrape trace spans -> trace.json")
+    parser.add_argument("--prometheus", action="store_true",
+                        help="also write per-peer .prom text expositions")
+    parser.add_argument("--timeout", type=float, default=10.0,
+                        help="per-scrape RPC timeout (s)")
+    parser.add_argument("--discover-seconds", type=float, default=2.0,
+                        help="how long to wait for peer discovery")
+    args = parser.parse_args(argv)
+
+    from moolib_tpu.utils import ensure_platforms
+
+    ensure_platforms()  # JAX_PLATFORMS=cpu must never touch a TPU tunnel
+
+    # The scraper is one more peer on the plane; its own telemetry is off
+    # so the dump doesn't include the act of dumping.
+    rpc = Rpc("telemetry-dump", telemetry=Telemetry("dump", enabled=False))
+    rpc.set_timeout(args.timeout)
+    try:
+        for addr in args.connect:
+            rpc.connect(addr)
+        want = (set(args.peers.split(",")) if args.peers else None)
+        # Seed the crawl with the directly-dialed peers (the connection
+        # table never grows spontaneously — gossip is on demand), or with
+        # the pinned --peers set (resolved by name via find-peer gossip).
+        deadline = time.monotonic() + args.discover_seconds
+        seeds: "set[str]" = set()
+        while True:
+            seeds = set(rpc.debug_info()["peers"])
+            if seeds or time.monotonic() > deadline:
+                break
+            time.sleep(0.05)
+        if want is not None:
+            seeds = set(want)
+        if not seeds:
+            print("error: no peers discovered via "
+                  f"{args.connect}", file=sys.stderr)
+            return 1
+
+        os.makedirs(args.out, exist_ok=True)
+        metrics: "dict[str, dict]" = {}
+        traces: "list[tuple[str, dict]]" = []
+        failed: "list[str]" = []
+        prom_files: "set[str]" = set()
+        queue = sorted(seeds)
+        visited = set(queue)
+        while queue:
+            peer = queue.pop(0)
+            try:
+                snap, prom = scrape(rpc, peer, args.spans, args.prometheus)
+            except (RpcError, TimeoutError, ValueError) as e:
+                # Keep scraping the rest of the cohort; a dark peer is a
+                # finding, not a reason to lose everyone else's data.
+                print(f"FAIL {peer}: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                failed.append(peer)
+                continue
+            metrics[peer] = snap["metrics"]
+            if args.spans and "trace" in snap:
+                traces.append((peer, snap["trace"]))
+            if prom is not None:
+                # Peer names come off the wire (crawled from remote
+                # replies) — never let one name a path outside --out, and
+                # never let two distinct names ("a:b" vs "a_b") silently
+                # share one file.
+                safe = re.sub(r"[^A-Za-z0-9._-]", "_", peer).lstrip(".")
+                safe = safe or "peer"
+                if safe in prom_files:
+                    digest = hashlib.sha1(peer.encode()).hexdigest()[:8]
+                    safe = f"{safe}-{digest}"
+                prom_files.add(safe)
+                with open(os.path.join(args.out, f"{safe}.prom"), "w") as f:
+                    f.write(prom)
+            print(f"ok   {peer}: {len(snap['metrics'])} series"
+                  + (f", {sum(1 for e in snap['trace']['traceEvents'] if e.get('ph') != 'M')} spans"
+                     if args.spans and "trace" in snap else ""))
+            if want is None:
+                # Crawl: the reply advertises the peer's dialable
+                # neighbours; walk the whole connected cohort.
+                me = rpc.get_name()
+                for nxt in snap.get("peers", []):
+                    if nxt != me and nxt not in visited:
+                        visited.add(nxt)
+                        queue.append(nxt)
+
+        with open(os.path.join(args.out, "metrics.json"), "w") as f:
+            json.dump(metrics, f, indent=2, sort_keys=True)
+        if args.spans:
+            merged = merge_chrome_traces(traces)
+            with open(os.path.join(args.out, "trace.json"), "w") as f:
+                json.dump(merged, f)
+            n = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+            print(f"wrote {args.out}/trace.json ({n} merged events)")
+        print(f"wrote {args.out}/metrics.json "
+              f"({len(metrics)}/{len(visited)} peers)")
+        return 1 if failed or not metrics else 0
+    finally:
+        rpc.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
