@@ -9,7 +9,12 @@ table; the driver's north-star is 1M env-steps/sec across a TPU v4-32
 (32 cores), i.e. 31,250 env-steps/sec/core. ``vs_baseline`` is measured
 throughput relative to that per-chip north-star share.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — the
+contract the external bench driver's BENCH_r{NN}.json collector expects.
+Since PR 7 this is a thin wrapper over the perfwatch harness
+(moolib_tpu/bench/): the same run also lands a full harness-schema row in
+the trend store when MOOLIB_TRENDS names one (tools/chip_session.py and
+tools/perf.py --suite device set it). See docs/perf.md.
 """
 
 from __future__ import annotations
@@ -117,20 +122,29 @@ def main() -> None:
     flops_per_step = impala_train_flops((T + 1) * B, num_actions=A)
     achieved = flops_per_step * iters / dt / max(1, n_chips)
     peak = device_peak_flops(devices[0].device_kind)
-    print(
-        json.dumps(
-            {
-                "metric": "impala_train_env_steps_per_sec_per_chip",
-                "value": round(per_chip, 1),
-                "unit": "env-steps/s/chip",
-                "vs_baseline": round(per_chip / NORTH_STAR_PER_CHIP, 3),
-                "mfu": round(achieved / peak, 4) if peak else None,
-                "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
-                "device_kind": devices[0].device_kind,
-                "tunnel_probe_attempts": probe["attempts"],
-                "tunnel_waited_s": probe["waited_s"],
-            }
-        )
+    legacy = {
+        "metric": "impala_train_env_steps_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "env-steps/s/chip",
+        "vs_baseline": round(per_chip / NORTH_STAR_PER_CHIP, 3),
+        "mfu": round(achieved / peak, 4) if peak else None,
+        "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
+        "device_kind": devices[0].device_kind,
+        "tunnel_probe_attempts": probe["attempts"],
+        "tunnel_waited_s": probe["waited_s"],
+    }
+    print(json.dumps(legacy))
+
+    # Harness-schema row into the trend store (no-op unless MOOLIB_TRENDS
+    # is set): the same number, full provenance, device-suite series.
+    from moolib_tpu.bench.harness import append_device_trend
+
+    append_device_trend(
+        legacy["metric"], per_chip, legacy["unit"], "python bench.py",
+        stats={"n": 1, "timed_s": dt, "iters": iters,
+               "frames_per_iter": T * B},
+        extra={k: v for k, v in legacy.items()
+               if k not in ("metric", "value", "unit")},
     )
 
 

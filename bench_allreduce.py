@@ -11,15 +11,31 @@ reduce planes of this framework:
   intra-cohort path (on CPU this exercises the virtual mesh; on a pod it
   rides ICI).
 
-Prints one JSON line per (plane, size): {"plane", "peers", "mb", "gbps"}.
-The headline driver benchmark stays ``bench.py``.
+Prints one JSON line per (plane, size): {"plane", "peers", "mb", "gbps"}
+(the unchanged collector contract). Since PR 7 each line also lands as a
+perfwatch harness row in the trend store when MOOLIB_TRENDS names one —
+one series per (plane, size) so the regression detector never compares
+different payload sizes. See docs/perf.md.
 """
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import json
 import threading
 import time
+
+
+def _trend_row(plane: str, peers: int, mb: float, gbps: float, cmd: str):
+    """One harness-schema trend row per (plane, size) series; no-op
+    unless MOOLIB_TRENDS is set."""
+    from moolib_tpu.bench.harness import append_device_trend
+
+    append_device_trend(
+        f"allreduce_{plane}_gbps_{mb:g}mb", gbps, "GB/s", cmd,
+        extra={"plane": plane, "peers": peers, "mb": mb},
+    )
 
 
 def _tree_worker(rank: int, n_peers: int, addr: str, sizes, out_q):
@@ -68,6 +84,8 @@ def _tree_worker(rank: int, n_peers: int, addr: str, sizes, out_q):
             assert abs(float(result[0]) - expect) < 1e-5
             if rank == 0:
                 out_q.put(("result", size, dt))
+    except (asyncio.CancelledError, concurrent.futures.CancelledError):
+        raise  # never swallow task cancellation
     except Exception as e:
         out_q.put(("error", rank, f"{type(e).__name__}: {e}"))
     finally:
@@ -116,11 +134,14 @@ def bench_rpc_tree(n_peers: int = 4, sizes=(2**16, 2**20, 2**23)):
             # Algorithm bandwidth: each peer contributes + receives the full
             # buffer once per round.
             gbps = a * 4 * n_peers / dt / 1e9
+            mb = round(a * 4 / 1e6, 2)
             print(json.dumps({
                 "plane": "dcn_rpc_tree", "peers": n_peers,
-                "mb": round(a * 4 / 1e6, 2),
+                "mb": mb,
                 "ms": round(dt * 1e3, 2), "gbps": round(gbps, 3),
             }), flush=True)
+            _trend_row("dcn_rpc_tree", n_peers, mb, gbps,
+                       "python bench_allreduce.py")
     finally:
         for p in procs:
             p.join(timeout=30)
@@ -182,11 +203,13 @@ def bench_ici_psum(sizes=(2**20, 2**23, 2**25)):
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / rounds
         gbps = size * 4 * n / dt / 1e9
+        mb = round(size * 4 / 1e6, 2)
         print(json.dumps({
             "plane": plane, "peers": n,
-            "mb": round(size * 4 / 1e6, 2),
+            "mb": mb,
             "ms": round(dt * 1e3, 2), "gbps": round(gbps, 3),
         }))
+        _trend_row(plane, n, mb, gbps, "python bench_allreduce.py")
 
 
 if __name__ == "__main__":

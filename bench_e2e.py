@@ -11,6 +11,9 @@ H2D, and RPC control — everything the learner-only bench excludes.
 Prints ONE JSON line:
   {"metric": "impala_e2e_env_steps_per_sec", "value", "unit",
    "learner_only_gap_note"}
+(the unchanged collector contract). Since PR 7 the run also lands a
+perfwatch harness row in the trend store when MOOLIB_TRENDS names one.
+See docs/perf.md.
 """
 
 from __future__ import annotations
@@ -67,23 +70,30 @@ def main(duration: float = 60.0) -> None:
         sps = steps / max(span, 1e-9)
     else:
         sps = total_steps / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "impala_e2e_env_steps_per_sec",
-                "value": round(sps, 1),
-                "unit": "env-steps/s (1 peer, acting+batching+H2D+train)",
-                "total_env_steps": int(total_steps),
-                "wall_s": round(elapsed, 1),
-                "tunnel_probe_attempts": probe["attempts"],
-                "tunnel_waited_s": probe["waited_s"],
-                "learner_only_gap_note": (
-                    "bench.py measures the resident-batch train step alone; "
-                    "the difference to this number is host pipeline cost "
-                    "(env stepping, batching, H2D, RPC control)"
-                ),
-            }
-        )
+    legacy = {
+        "metric": "impala_e2e_env_steps_per_sec",
+        "value": round(sps, 1),
+        "unit": "env-steps/s (1 peer, acting+batching+H2D+train)",
+        "total_env_steps": int(total_steps),
+        "wall_s": round(elapsed, 1),
+        "tunnel_probe_attempts": probe["attempts"],
+        "tunnel_waited_s": probe["waited_s"],
+        "learner_only_gap_note": (
+            "bench.py measures the resident-batch train step alone; "
+            "the difference to this number is host pipeline cost "
+            "(env stepping, batching, H2D, RPC control)"
+        ),
+    }
+    print(json.dumps(legacy))
+
+    from moolib_tpu.bench.harness import append_device_trend
+
+    append_device_trend(
+        legacy["metric"], sps, "env-steps/s",
+        f"python bench_e2e.py {duration:g}",
+        stats={"n": 1, "wall_s": elapsed,
+               "total_env_steps": int(total_steps)},
+        extra={"tunnel_probe_attempts": probe["attempts"]},
     )
 
 

@@ -631,15 +631,15 @@ class ProjectIndex:
 def all_rules() -> List[Rule]:
     """The full registered rule set (async-safety + JAX trace hygiene +
     sharding/collective consistency + RPC round/counter balance + RPC
-    wire-surface consistency)."""
-    from . import (rules_async, rules_jax, rules_protocol, rules_sharding,
-                   rules_wire)
+    wire-surface consistency + benchmark timing hygiene)."""
+    from . import (rules_async, rules_bench, rules_jax, rules_protocol,
+                   rules_sharding, rules_wire)
 
     return [
         cls()
         for cls in (rules_async.RULES + rules_jax.RULES
                     + rules_sharding.RULES + rules_protocol.RULES
-                    + rules_wire.RULES)
+                    + rules_wire.RULES + rules_bench.RULES)
     ]
 
 

@@ -1,0 +1,45 @@
+"""perfwatch: the unified benchmark harness, CPU-proxy suite, telemetry-
+derived budgets, and the append-only trend store + regression detector.
+
+One CLI fronts all of it: ``python tools/perf.py`` (see docs/perf.md).
+The legacy entry points (``bench.py``, ``bench_allreduce.py``,
+``bench_e2e.py``, ``tools/perf_sweep.py``, ``tools/envpool_bench.py``,
+``tools/attn_bench.py``) stay as thin wrappers that keep their one-line
+JSON contracts while feeding the same trend schema through
+:func:`~moolib_tpu.bench.harness.maybe_append_trend`.
+"""
+
+from .harness import (
+    SCHEMA_VERSION,
+    BenchResult,
+    clock,
+    env_fingerprint,
+    maybe_append_trend,
+    measure,
+    parse_result,
+    trimmed_stats,
+)
+from .budgets import CPU_PROXY_BUDGETS, Budget, BudgetBreach, evaluate_budgets
+from .suite import CPU_PROXY_SUITE, run_suite
+from .trends import Regression, append_trend, detect_regressions, load_trends
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "BenchResult",
+    "Budget",
+    "BudgetBreach",
+    "CPU_PROXY_BUDGETS",
+    "CPU_PROXY_SUITE",
+    "Regression",
+    "append_trend",
+    "clock",
+    "detect_regressions",
+    "env_fingerprint",
+    "evaluate_budgets",
+    "load_trends",
+    "maybe_append_trend",
+    "measure",
+    "parse_result",
+    "run_suite",
+    "trimmed_stats",
+]

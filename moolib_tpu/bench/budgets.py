@@ -1,0 +1,138 @@
+"""perfwatch budgets: absolute guardrails derived from telemetry histograms.
+
+Two layers of perf gating with different jobs:
+
+- the **trend detector** (:mod:`.trends`) is the sensitive instrument —
+  it flags a real slowdown relative to this host's own history;
+- the **budgets** here are coarse absolute guardrails — they catch
+  "something is catastrophically wrong" on the very first run (no history
+  needed) and are set generously (5-10x headroom over measured CI values)
+  so a loaded container never reds the gate on noise.
+
+Budgets read the telemetry-registry snapshot attached to each
+:class:`~.harness.BenchResult`: latency ceilings come from the p99/p50
+quantile keys the registry stamps on every exported histogram series
+(``telemetry/registry.py``'s log-bucket estimator), so the budget checks
+the *distribution the benchmark actually produced*, not just its headline
+median. Value floors/ceilings cover benchmarks whose headline is a
+throughput.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from .harness import BenchResult
+
+__all__ = ["Budget", "BudgetBreach", "CPU_PROXY_BUDGETS", "evaluate_budgets"]
+
+
+@dataclasses.dataclass
+class Budget:
+    """Guardrails for one metric. ``value_min``/``value_max`` bound the
+    headline value; ``quantiles`` maps a telemetry histogram series (by
+    ``name`` + required label substring) to ``{p-key: ceiling-seconds}``
+    read from the attached snapshot."""
+
+    value_min: Optional[float] = None
+    value_max: Optional[float] = None
+    # [(series_name, label_substring, {"p99": ceiling_s, ...}), ...]
+    quantiles: List = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class BudgetBreach:
+    metric: str
+    what: str       # "value" or the histogram series id
+    observed: float
+    limit: float
+    kind: str       # "floor" or "ceiling"
+    cmd: str
+
+    def message(self) -> str:
+        rel = "under floor" if self.kind == "floor" else "over ceiling"
+        return (
+            f"{self.metric}: {self.what} = {self.observed:.6g} {rel} "
+            f"{self.limit:.6g}; reproduce: {self.cmd or '<no cmd recorded>'}"
+        )
+
+
+#: Guardrails for the CPU-proxy suite. Ceilings/floors carry 5-10x
+#: headroom over values measured on the 1-core CI container (docs/perf.md
+#: records the measurement basis) — these catch catastrophes, not drifts.
+CPU_PROXY_BUDGETS: Dict[str, Budget] = {
+    # Loopback in-process echo: ~1 ms/call measured with telemetry on.
+    "rpc_echo_latency_s": Budget(
+        value_max=0.05,
+        quantiles=[
+            ("rpc_server_handle_seconds", 'endpoint="echo"', {"p99": 0.5}),
+            ("rpc_client_latency_seconds", 'endpoint="echo"', {"p50": 0.1}),
+        ],
+    ),
+    # Large-payload echo throughput: ~0.5+ GB/s loopback measured.
+    "rpc_payload_gbps": Budget(value_min=0.02),
+    # 4-peer loopback tree allreduce: one core pays every copy; floor is
+    # far under the ~0.1+ GB/s a healthy build does at smoke sizes.
+    "allreduce_tree_gbps": Budget(value_min=0.005),
+    # Batcher fill: B tiny stacks, ~ms on a healthy build.
+    "batcher_fill_s": Budget(
+        value_max=0.25,
+        quantiles=[("batcher_fill_seconds", "perfwatch", {"p99": 1.0})],
+    ),
+    # Trivial-env pool: tens of thousands steps/s measured (ENVPOOL_r04);
+    # floor catches a wedged dispatch path, not a slow one.
+    "envpool_steps_per_s": Budget(
+        value_min=500.0,
+        quantiles=[("envpool_step_seconds", "", {"p99": 1.0})],
+    ),
+    # serial.py encode/decode of a tensor-bearing payload: memcpy-bound,
+    # multiple GB/s measured.
+    "serial_encode_gbps": Budget(value_min=0.1),
+    "serial_decode_gbps": Budget(value_min=0.1),
+}
+
+
+def _find_series(
+    snapshot: Dict[str, Any], name: str, label_substring: str
+) -> Optional[Dict[str, Any]]:
+    for sid, series in snapshot.items():
+        if not sid.startswith(name):
+            continue
+        base = sid.split("{", 1)[0]
+        if base == name and label_substring in sid:
+            return series
+    return None
+
+
+def evaluate_budgets(
+    result: BenchResult, budgets: Optional[Dict[str, Budget]] = None
+) -> List[BudgetBreach]:
+    """All guardrail breaches for one result (empty when in budget, when
+    no budget is declared for the metric, or when the result is a null
+    artifact — nulls are the trend layer's business, not a budget's)."""
+    budgets = CPU_PROXY_BUDGETS if budgets is None else budgets
+    b = budgets.get(result.metric)
+    if b is None or result.value is None:
+        return []
+    out: List[BudgetBreach] = []
+    v = float(result.value)
+    if b.value_min is not None and v < b.value_min:
+        out.append(BudgetBreach(result.metric, "value", v, b.value_min,
+                                "floor", result.cmd))
+    if b.value_max is not None and v > b.value_max:
+        out.append(BudgetBreach(result.metric, "value", v, b.value_max,
+                                "ceiling", result.cmd))
+    snap = result.telemetry or {}
+    for name, label_sub, ceilings in b.quantiles:
+        series = _find_series(snap, name, label_sub)
+        if series is None:
+            continue  # seam not exercised in this mode; value bounds hold
+        for pkey, ceiling in ceilings.items():
+            q = series.get(pkey)
+            if q is not None and q > ceiling:
+                out.append(BudgetBreach(
+                    result.metric, f"{name}[{label_sub or '*'}].{pkey}",
+                    float(q), float(ceiling), "ceiling", result.cmd,
+                ))
+    return out

@@ -36,12 +36,14 @@ from typing import Any, Dict, Optional
 
 from .registry import (
     DEFAULT_TIME_EDGES,
+    EXPORT_QUANTILES,
     FRACTION_EDGES,
     Counter,
     Gauge,
     Histogram,
     Registry,
     parse_prometheus,
+    quantile_from_export,
 )
 from .trace import Span, TraceBuffer, now_us, spans_to_chrome
 
@@ -54,9 +56,11 @@ __all__ = [
     "TraceBuffer",
     "Span",
     "DEFAULT_TIME_EDGES",
+    "EXPORT_QUANTILES",
     "FRACTION_EDGES",
     "global_telemetry",
     "parse_prometheus",
+    "quantile_from_export",
     "publish_metrics",
     "now_us",
     "spans_to_chrome",

@@ -34,7 +34,7 @@ import math
 import re
 import threading
 from bisect import bisect_left, insort
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter",
@@ -43,7 +43,9 @@ __all__ = [
     "Registry",
     "DEFAULT_TIME_EDGES",
     "FRACTION_EDGES",
+    "EXPORT_QUANTILES",
     "parse_prometheus",
+    "quantile_from_export",
 ]
 
 #: Default histogram edges: powers of two covering 1µs .. 64s — the
@@ -55,6 +57,69 @@ DEFAULT_TIME_EDGES: Tuple[float, ...] = tuple(
 
 #: Edges for ratios in [0, 1] (batch fill fractions): eighths.
 FRACTION_EDGES: Tuple[float, ...] = tuple(i / 8.0 for i in range(1, 9))
+
+#: Quantiles stamped into every histogram export: JSON ``p50``/``p95``/
+#: ``p99`` keys and Prometheus ``{quantile="..."}`` samples. The perf
+#: budget layer (``moolib_tpu/bench/budgets.py``) reads these straight
+#: off scraped snapshots.
+EXPORT_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+
+
+def _quantile_from_cum(
+    edges: Sequence[float], cum: Sequence[int], q: float
+) -> Optional[float]:
+    """Quantile estimate from cumulative bucket counts (``+Inf`` last).
+
+    Log-bucket interpolation: within a bucket whose lower edge is
+    positive, the mass is assumed log-uniform (matching the power-of-two
+    default edges), so the estimate is ``lo * (hi/lo)**frac``; the first
+    bucket (lower edge 0) interpolates linearly. Two exactness anchors
+    keep the estimator honest and the tests pinnable:
+
+    - a rank landing exactly on a cumulative bucket boundary returns that
+      bucket's upper edge *exactly* (no interpolation drift);
+    - ranks inside the implicit ``+Inf`` bucket clamp to the largest
+      finite edge (there is no upper edge to interpolate toward), so the
+      estimate is a stated lower bound rather than an invention.
+
+    Returns ``None`` for an empty histogram. Monotone non-decreasing in
+    ``q`` by construction.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    total = cum[-1]
+    if total <= 0:
+        return None
+    target = q * total
+    if target <= 0:
+        # q == 0: the lower edge of the first non-empty bucket.
+        i = next(j for j, c in enumerate(cum) if c > 0)
+        return float(edges[i - 1]) if i > 0 else 0.0
+    i = bisect_left(cum, target)
+    if i >= len(edges):
+        return float(edges[-1])  # +Inf bucket: clamp, lower bound
+    if cum[i] == target:
+        return float(edges[i])  # exact boundary hit: the edge itself
+    prev = cum[i - 1] if i > 0 else 0
+    frac = (target - prev) / (cum[i] - prev)
+    lo = float(edges[i - 1]) if i > 0 else 0.0
+    hi = float(edges[i])
+    if lo > 0.0:
+        return lo * (hi / lo) ** frac
+    return lo + (hi - lo) * frac
+
+
+def quantile_from_export(series: Dict[str, Any], q: float) -> Optional[float]:
+    """Quantile estimate from an exported histogram series dict (the
+    ``{"type": "histogram", "edges": [...], "buckets": [...]}`` shape a
+    :meth:`Registry.snapshot` or a ``__telemetry`` scrape carries) — so
+    p50/p99 come straight from existing snapshots with no live object.
+    """
+    if series.get("type") != "histogram":
+        raise ValueError(
+            f"quantiles need a histogram series, got {series.get('type')!r}"
+        )
+    return _quantile_from_cum(series["edges"], series["buckets"], q)
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -210,6 +275,11 @@ class Histogram:
             out.append(running)
         return out
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Log-bucket quantile estimate (see :func:`_quantile_from_cum`);
+        ``None`` while the histogram is empty."""
+        return _quantile_from_cum(self.edges, self.cumulative(), q)
+
     def _export(self) -> Dict[str, Any]:
         with self._lock:
             counts = list(self._counts)
@@ -218,13 +288,17 @@ class Histogram:
         for c in counts:
             running += c
             cum.append(running)
-        return {
+        out = {
             "type": "histogram",
             "edges": list(self.edges),
             "buckets": cum,  # cumulative, +Inf last — monotone by construction
             "sum": s,
             "count": total,
         }
+        for q in EXPORT_QUANTILES:
+            # None (not NaN) while empty: snapshots must stay strict JSON.
+            out[f"p{q * 100:g}"] = _quantile_from_cum(self.edges, cum, q)
+        return out
 
 
 class Registry:
@@ -375,6 +449,15 @@ class Registry:
                 lines.append(
                     f"{series_id(name + '_count', labels)} {exp['count']}"
                 )
+                for q in EXPORT_QUANTILES:
+                    # Summary-style quantile samples next to the buckets
+                    # (empty histogram -> NaN, the Prometheus idiom).
+                    qv = exp[f"p{q * 100:g}"]
+                    ql = labels + (("quantile", f"{q:g}"),)
+                    lines.append(
+                        f"{series_id(name, ql)} "
+                        f"{_format_value(float('nan') if qv is None else qv)}"
+                    )
             else:
                 lines.append(
                     f"{series_id(name, labels)} {_format_value(m.value)}"

@@ -125,6 +125,18 @@ def test_chip_session_rehearsal_writes_all_artifacts(tmp_path):
     assert [s["stage"] for s in log["stages"]] == [
         "bench", "perf_sweep", "attn_bench", "bench_e2e"
     ]
+    # ISSUE 7: the rehearsed session appends harness-schema rows to the
+    # perfwatch trend store — every stage family represented, every row
+    # schema-valid (so a live tunnel window leaves a usable history).
+    from moolib_tpu.bench import load_trends
+
+    rows = load_trends(str(tmp_path / "trends.jsonl"))
+    metrics = {r.metric for r in rows}
+    assert "impala_train_env_steps_per_sec_per_chip" in metrics
+    assert "impala_e2e_env_steps_per_sec" in metrics
+    assert any(m.startswith("sweep_") for m in metrics), metrics
+    assert any(m.startswith("attn_") for m in metrics), metrics
+    assert all(r.suite == "device" and r.value is not None for r in rows)
 
 
 def test_chip_session_stage_runner_captures_json(tmp_path):

@@ -71,11 +71,15 @@ def test_cli_clean_tree_exits_zero():
 
 def test_tools_and_tests_trees_clean():
     """The non-package trees are enforced against their own (empty unless
-    debt accrues) baseline — the second ci_check.sh lint stage."""
+    debt accrues) baseline — the second ci_check.sh lint stage. The root
+    bench scripts ride along (ISSUE 7) so the bench-wallclock rule covers
+    every file that quotes a duration."""
     if not BASELINE_TOOLS.exists():
         pytest.skip("no tools/tests lint baseline checked in")
     findings = lint_paths(
-        [REPO_ROOT / "tools", REPO_ROOT / "tests"], root=REPO_ROOT
+        [REPO_ROOT / "tools", REPO_ROOT / "tests",
+         REPO_ROOT / "bench.py", REPO_ROOT / "bench_allreduce.py",
+         REPO_ROOT / "bench_e2e.py"], root=REPO_ROOT
     )
     new, _fixed = diff_against_baseline(
         findings, load_baseline(BASELINE_TOOLS)
@@ -1682,6 +1686,120 @@ def test_wire_baselines_are_empty():
         if not path.exists():
             pytest.skip("baseline not checked in")
         assert load_baseline(path)["findings"] == [], path
+
+
+# -- bench timing hygiene (ISSUE 7) ------------------------------------------
+
+
+def _lint_bench(src, relpath="tools/fake_bench.py"):
+    return lint_source(textwrap.dedent(src), relpath,
+                       only=["bench-wallclock"])
+
+
+def test_bench_wallclock_flags_direct_and_var_flow_durations():
+    src = """
+    import time
+    def run():
+        t0 = time.time()
+        work()
+        dt = time.time() - t0
+        t1 = time.time()
+        span = t1 - t0
+        return dt, span
+    """
+    findings = _lint_bench(src)
+    assert [f.rule for f in findings] == ["bench-wallclock"] * 2
+    assert {f.line for f in findings} == {6, 8}  # the two subtractions
+
+
+def test_bench_wallclock_clean_perf_counter_and_stamps():
+    src = """
+    import time
+    def run():
+        t0 = time.perf_counter()
+        work()
+        dt = time.perf_counter() - t0           # harness clock: fine
+        row = {"t": time.time()}                 # wall STAMP: fine
+        deadline = time.time() + 20              # deadline compare: fine
+        while time.time() < deadline:
+            pass
+        return dt, row
+    """
+    assert _lint_bench(src) == []
+
+
+def test_bench_wallclock_scoped_to_bench_and_tools_trees():
+    src = """
+    import time
+    def run():
+        t0 = time.time()
+        return time.time() - t0
+    """
+    # Non-bench package/test code has legitimate wall-clock duration uses
+    # (checkpoint cadences, trace placement) — out of this rule's scope.
+    assert _lint_bench(src, relpath="moolib_tpu/rpc/rpc.py") == []
+    assert _lint_bench(src, relpath="tests/test_x.py") == []
+    # bench-NAMED files deeper in the package are not automatically
+    # benchmarks; only root-level bench*.py scripts match by name.
+    assert _lint_bench(src, relpath="moolib_tpu/examples/bench_x.py") == []
+    # Bench-bearing trees all in scope.
+    for rel in ("bench.py", "tools/envpool_bench.py",
+                "moolib_tpu/bench/suite.py",
+                "moolib_tpu/utils/benchmark.py"):
+        assert _lint_bench(src, relpath=rel), rel
+
+
+def test_bench_wallclock_rebinding_is_order_sensitive():
+    """A name used for a perf_counter duration and LATER rebound to a
+    wall stamp must not retroactively taint the earlier subtraction; a
+    perf_counter rebind likewise clears taint going forward."""
+    src = """
+    import time
+    def run():
+        t0 = time.perf_counter()
+        work()
+        dt = time.perf_counter() - t0            # clean duration
+        t0 = time.time()                          # artifact stamp, later
+        row = {"started": t0}
+        return dt, row
+    """
+    assert _lint_bench(src) == []
+    src2 = """
+    import time
+    def run():
+        t0 = time.time()
+        bad = time.time() - t0                    # flags
+        t0 = time.perf_counter()
+        good = time.perf_counter() - t0           # rebind cleared taint
+        return bad, good
+    """
+    findings = _lint_bench(src2)
+    assert [f.line for f in findings] == [5]
+
+
+def test_bench_wallclock_var_binding_is_scope_local():
+    """A name bound to time.time() in one function must not taint the
+    same name in another scope."""
+    src = """
+    import time
+    def stamp():
+        t0 = time.time()
+        return t0
+    def measure():
+        t0 = time.perf_counter()
+        return time.perf_counter() - t0
+    """
+    assert _lint_bench(src) == []
+
+
+def test_bench_wallclock_line_suppression():
+    src = """
+    import time
+    def run():
+        t0 = time.time()
+        return time.time() - t0  # moolint: disable=bench-wallclock
+    """
+    assert _lint_bench(src) == []
 
 
 def test_line_suppression_comment():

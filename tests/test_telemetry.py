@@ -92,6 +92,90 @@ def test_histogram_rejects_bad_edges():
 
 
 # ---------------------------------------------------------------------------
+# Quantile estimation (ISSUE 7: p50/p99 straight from Histogram snapshots).
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_exact_at_bucket_edges():
+    """A rank landing exactly on a cumulative bucket boundary returns
+    that bucket's upper edge EXACTLY — no interpolation drift."""
+    h = Registry().histogram("h", edges=(1.0, 2.0, 4.0))
+    for v in (1.0, 2.0, 4.0):
+        h.observe(v)
+    # cum = [1, 2, 3, 3]: ranks 1/3, 2/3, 1.0 land on boundaries.
+    assert h.quantile(1 / 3) == 1.0
+    assert h.quantile(2 / 3) == 2.0
+    assert h.quantile(1.0) == 4.0
+
+
+def test_quantile_log_interpolation_and_first_bucket_linear():
+    h = Registry().histogram("h", edges=(1.0, 2.0))
+    h.observe(1.5)  # one observation in the (1, 2] bucket
+    # Geometric midpoint of a log bucket: sqrt(lo*hi).
+    assert h.quantile(0.5) == pytest.approx(math.sqrt(2.0))
+    h0 = Registry().histogram("h0", edges=(1.0, 2.0))
+    h0.observe(0.5)  # first bucket has no positive lower edge
+    assert h0.quantile(0.5) == pytest.approx(0.5, abs=0.51)  # linear in [0,1]
+    assert 0.0 < h0.quantile(0.5) <= 1.0
+
+
+def test_quantile_monotone_across_quantiles_and_inf_clamp():
+    import random
+
+    rng = random.Random(5)
+    h = Registry().histogram("h", edges=(1.0, 2.0, 4.0, 8.0))
+    for _ in range(200):
+        h.observe(rng.uniform(0.0, 16.0))  # some mass lands past 8 (+Inf)
+    qs = [h.quantile(q / 100) for q in range(0, 101)]
+    assert all(a <= b for a, b in zip(qs, qs[1:])), qs
+    # Ranks inside the +Inf bucket clamp to the largest finite edge — a
+    # stated lower bound, never an invented value.
+    assert qs[-1] == 8.0
+
+
+def test_quantile_empty_bad_q_and_export_round_trip():
+    from moolib_tpu.telemetry import quantile_from_export
+
+    r = Registry()
+    h = r.histogram("h", edges=(1.0, 2.0))
+    assert h.quantile(0.5) is None  # empty: no verdict
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    h.observe(1.5)
+    exp = h._export()
+    # Snapshot carries p50/p95/p99, and the standalone estimator over the
+    # exported dict agrees with the live object.
+    assert exp["p50"] == h.quantile(0.5)
+    assert exp["p95"] == h.quantile(0.95)
+    assert exp["p99"] == h.quantile(0.99)
+    assert quantile_from_export(exp, 0.5) == h.quantile(0.5)
+    with pytest.raises(ValueError, match="histogram"):
+        quantile_from_export({"type": "counter", "value": 1.0}, 0.5)
+    # Empty histograms export None (strict-JSON snapshots, no NaN).
+    empty = r.histogram("e", edges=(1.0,))._export()
+    assert empty["p50"] is None
+    import json as _json
+
+    _json.dumps(exp, allow_nan=False)
+
+
+def test_quantile_samples_in_prometheus_export_parse_strict():
+    r = Registry()
+    h = r.histogram("lat_seconds", edges=(1.0, 2.0), endpoint="echo")
+    h.observe(1.5)
+    text = r.prometheus()
+    parsed = parse_prometheus(text)
+    key = 'lat_seconds{endpoint="echo",quantile="0.5"}'
+    assert key in parsed
+    assert parsed[key] == pytest.approx(h.quantile(0.5))
+    # Empty histogram quantiles export as NaN samples — still strict-parse.
+    r2 = Registry()
+    r2.histogram("empty_seconds", edges=(1.0,))
+    parsed2 = parse_prometheus(r2.prometheus())
+    assert math.isnan(parsed2['empty_seconds{quantile="0.99"}'])
+
+
+# ---------------------------------------------------------------------------
 # Registry semantics + snapshot determinism.
 # ---------------------------------------------------------------------------
 

@@ -177,6 +177,21 @@ def main():
         with open(args.json, "w") as f:
             json.dump(row, f, indent=1)
 
+    # Harness-schema trend row (no-op unless MOOLIB_TRENDS is set): the
+    # chunked-pipeline speedup at this injected link speed is the number
+    # that must not regress.
+    from moolib_tpu.bench.harness import append_device_trend
+
+    append_device_trend(
+        f"allreduce_chunked_speedup_{args.link_mbps:g}mbps",
+        row["chunked_speedup"], "x",
+        f"python tools/allreduce_latency_ab.py --mb {args.mb:g} "
+        f"--link-mbps {args.link_mbps:g} --peers {args.peers}",
+        extra={k: row[k] for k in
+               ("peers", "mb", "link_mbps", "unchunked_s",
+                "chunked_depth4_s")},
+    )
+
 
 if __name__ == "__main__":
     main()

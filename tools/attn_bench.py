@@ -16,6 +16,9 @@ accordingly); the judge-facing artifact comes from a TPU run via
 tools/chip_session.py.
 
 Usage: python tools/attn_bench.py [--json ATTN_r04.json] [--quick]
+
+Per-(backend, T) rows also land as perfwatch harness rows when
+MOOLIB_TRENDS names a trend store. See docs/perf.md.
 """
 
 from __future__ import annotations
@@ -184,6 +187,7 @@ def main():
                     help="round number stamped into the artifact")
     args = ap.parse_args()
 
+    from moolib_tpu.bench.harness import append_device_trend
     from moolib_tpu.utils import ensure_platforms
 
     ensure_platforms()
@@ -249,6 +253,13 @@ def main():
                     row["attn_mfu"] = round(fl / dt / peak, 4)
                 art["rows"].append(row)
                 print(json.dumps(row), flush=True)
+                append_device_trend(
+                    f"attn_{backend}_T{T}_steps_per_sec",
+                    row["steps_per_sec"], "steps/s",
+                    "python tools/attn_bench.py",
+                    extra={"backend": backend, "T": T,
+                           "attn_tflops": row["attn_tflops"]},
+                )
             except Exception as e:
                 art["rows"].append({
                     "backend": backend, "T": T,

@@ -20,8 +20,14 @@ disk. A session log (CHIP_SESSION_r{NN}.json) records per-stage status.
 exercised end-to-end by tests/test_bench_tools.py, so the one live window
 cannot be wasted on a harness bug (VERDICT r4 #1).
 
+Every stage inherits ``MOOLIB_TRENDS`` (default: ``<out-dir>/trends.jsonl``)
+so the wrapped benchmarks append perfwatch harness rows to the same trend
+schema the CPU-proxy CI suite uses — a live tunnel window leaves a trend
+history, not just point artifacts. Gate the result afterwards with
+``python tools/perf.py --check-trends-only --trends <store>``.
+
 Usage: python tools/chip_session.py [--wait-budget 36000] [--round N]
-       [--out-dir DIR] [--rehearse]
+       [--out-dir DIR] [--rehearse] [--trends PATH]
 """
 
 from __future__ import annotations
@@ -82,6 +88,9 @@ def main():
                     help="assume the device is reachable now")
     ap.add_argument("--out-dir", default=REPO,
                     help="directory artifacts are written into")
+    ap.add_argument("--trends", default=None,
+                    help="perfwatch trend store the stages append to "
+                         "(default: <out-dir>/trends.jsonl; '' disables)")
     ap.add_argument(
         "--rehearse", action="store_true",
         help="CPU dry-rehearsal (VERDICT r4 #1): fake a tunnel window by "
@@ -118,6 +127,12 @@ def main():
 
     env = dict(os.environ)
     env["MOOLIB_BENCH_BUDGET"] = "300"  # stages re-probe briefly at most
+    # Stages append harness-schema rows to one trend store (perfwatch).
+    trends = args.trends if args.trends is not None else os.path.join(
+        out, "trends.jsonl")
+    if trends:
+        env["MOOLIB_TRENDS"] = os.path.abspath(trends)
+        log["trends"] = env["MOOLIB_TRENDS"]
     py = sys.executable
 
     if args.rehearse:

@@ -14,12 +14,15 @@ if [ -n "${GITHUB_ACTIONS:-}" ]; then fmt=gha; fi
 echo "== moolint: moolib_tpu/ =="
 python tools/moolint.py --check --format="$fmt" moolib_tpu/
 
-echo "== moolint: tools/ tests/ =="
+echo "== moolint: tools/ tests/ bench*.py =="
 # Separate baseline section for the non-package trees: they are held to
 # their own (currently empty) grandfather list so debt there can never
-# hide behind the package baseline — and vice versa.
+# hide behind the package baseline — and vice versa. The root bench
+# scripts ride along so the bench-wallclock rule covers every file that
+# quotes a duration.
 python tools/moolint.py --check --format="$fmt" \
-  --baseline moolib_tpu/analysis/baseline_tools.json tools/ tests/
+  --baseline moolib_tpu/analysis/baseline_tools.json tools/ tests/ \
+  bench.py bench_allreduce.py bench_e2e.py
 
 echo "== moolint: baselines must stay empty =="
 # The burn-down hit 0 in PR 3; --fail-nonempty turns any regression (a
@@ -28,12 +31,24 @@ python tools/moolint.py --baseline-stats --fail-nonempty
 python tools/moolint.py --baseline-stats --fail-nonempty \
   --baseline moolib_tpu/analysis/baseline_tools.json
 
-echo "== telemetry smoke =="
-# Live __telemetry scrape of a two-Rpc cohort (JSON + Prometheus text
-# through the strict parser, trace-id propagation) plus the disabled-mode
-# instrumentation overhead budget (<5% of echo latency, measured at the
-# gate so loopback noise can't flake it). See docs/observability.md.
+echo "== perf smoke =="
+# One stage, two layers (docs/perf.md):
+# 1. telemetry_smoke.py — live __telemetry scrape of a two-Rpc cohort
+#    (JSON + Prometheus through the strict parser, trace-id propagation)
+#    plus the disabled-mode instrumentation overhead budget (<5% of echo
+#    latency, measured at the gate so loopback noise can't flake it).
+# 2. perf.py --suite cpu-proxy --smoke — the CPU-proxy perf suite (RPC
+#    echo/payload, loopback tree allreduce, batcher fill, envpool
+#    steps/s, serial encode/decode) on OS-assigned ports, gated on
+#    telemetry-derived budgets and the trend-store regression detector.
+#    Emits GHA ::error annotations on breach (fmt is auto-picked from
+#    GITHUB_ACTIONS inside perf.py). The outer `timeout` is the hard
+#    wall-clock cap; perf.py's own --smoke cap (300s) nulls-and-fails
+#    stragglers before that. bench/trends.jsonl is the trend artifact —
+#    upload it from CI so history accretes across runs.
 env JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
+timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/perf.py \
+  --suite cpu-proxy --smoke --trends bench/trends.jsonl
 
 echo "== chaos smoke =="
 # Bounded seeded fault-injection pass (3 scenarios, well under 60s,

@@ -8,6 +8,11 @@ role of the reference's zero-copy EnvStepper design (reference:
 src/env.cc:273-412).
 
 Usage: python tools/envpool_bench.py [--json ENVPOOL_r04.json]
+
+Per-config results also land as perfwatch harness rows (one trend series
+per procs/batch-size config) when MOOLIB_TRENDS names a store; the
+CPU-proxy CI stage runs the same path as ``envpool_steps_per_s`` in
+moolib_tpu/bench/suite.py. See docs/perf.md.
 """
 
 from __future__ import annotations
@@ -61,11 +66,19 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
+    from moolib_tpu.bench.harness import append_device_trend
+
     results = {}
     for procs, bs in ((1, 32), (1, 128), (1, 512)):
         key = f"p{procs}_b{bs}"
         results[key] = bench(procs, bs)
         print(json.dumps({key: results[key]}), flush=True)
+        append_device_trend(
+            f"envpool_{key}_steps_per_sec",
+            results[key]["env_steps_per_sec"], "env-steps/s",
+            "python tools/envpool_bench.py",
+            extra={"procs": procs, "batch_size": bs},
+        )
     art = {
         "round": 4,
         "cmd": "python tools/envpool_bench.py",

@@ -83,6 +83,14 @@ MODULES = [
     ("moolib_tpu.analysis", "moolint: async-RPC safety, JAX trace hygiene, "
      "sharding/collective consistency + RPC round-balance static analysis "
      "(tier-1 enforced)"),
+    ("moolib_tpu.bench.harness", "perfwatch harness: timing protocol + "
+     "unified result schema"),
+    ("moolib_tpu.bench.suite", "CPU-proxy perf suite (runs on every PR, "
+     "tunnel or no tunnel)"),
+    ("moolib_tpu.bench.trends", "append-only trend store + noise-aware "
+     "regression detector"),
+    ("moolib_tpu.bench.budgets", "absolute perf guardrails from telemetry "
+     "histogram quantiles"),
     ("moolib_tpu.broker", "broker CLI (python -m moolib_tpu.broker)"),
 ]
 
@@ -158,11 +166,16 @@ def _index() -> str:
         "[analysis.md](analysis.md). Fault model, delivery guarantees, "
         "and seed replay: [reliability.md](reliability.md). Metric name "
         "catalogue, span semantics, and the scrape how-to: "
-        "[observability.md](observability.md).",
+        "[observability.md](observability.md). Benchmark harness "
+        "protocol, CPU-proxy suite, perf budgets, and the "
+        "trend/regression gate: [perf.md](perf.md).",
         "",
         "Other entry points:",
         "",
-        "- `bench.py` — headline learner benchmark (one JSON line).",
+        "- `tools/perf.py` — perfwatch CLI: CPU-proxy perf suite + "
+        "budgets + trend gate (CI stage), device-suite front end.",
+        "- `bench.py` — headline learner benchmark (one JSON line; "
+        "perfwatch wrapper).",
         "- `bench_e2e.py` — end-to-end acting+training benchmark.",
         "- `bench_allreduce.py` — DCN tree / ICI psum collective benchmark.",
         "- `tools/roofline.py`, `tools/perf_sweep.py`, "

@@ -4,7 +4,9 @@ protocol from bench.py (chained in-jit steps, D2H scalar readback).
 
 Usage: python tools/perf_sweep.py [config ...]
 Configs are "B=512,dtype=bf16" style key=value strings; no args runs the
-default grid. One JSON line per config.
+default grid. One JSON line per config (unchanged contract); each
+successful config also lands a perfwatch harness row — one trend series
+per config string — when MOOLIB_TRENDS names a store. See docs/perf.md.
 """
 
 from __future__ import annotations
@@ -127,12 +129,24 @@ def main():
             kv = dict(p.split("=") for p in arg.split(","))
             grid.append((int(kv.get("B", 256)), kv.get("dtype", "bf16"),
                          int(kv.get("s2d", 1)), int(kv.get("mxu", 0))))
+    from moolib_tpu.bench.harness import append_device_trend
+
     for cfg in grid:
         B, dtype, s2d = cfg[0], cfg[1], cfg[2]
         mxu = cfg[3] if len(cfg) > 3 else 0
         try:
-            print(json.dumps(run_config(B, dtype, s2d, mxu=mxu)),
-                  flush=True)
+            row = run_config(B, dtype, s2d, mxu=mxu)
+            print(json.dumps(row), flush=True)
+            cfg_id = f"B{B}_{dtype}_s2d{s2d}_mxu{mxu}"
+            append_device_trend(
+                f"sweep_{cfg_id}_env_steps_per_sec",
+                row["env_steps_per_sec"], "env-steps/s",
+                f"python tools/perf_sweep.py "
+                f"B={B},dtype={dtype},s2d={s2d},mxu={mxu}",
+                stats={"n": 1, "timed_s": row["timed_s"],
+                       "compile_s": row["compile_s"]},
+                extra={k: row[k] for k in ("tflops", "mfu") if k in row},
+            )
         except Exception as e:  # keep sweeping past OOMs
             print(json.dumps({"B": B, "dtype": dtype, "s2d": s2d,
                               "mxu": mxu, "error": repr(e)}), flush=True)
