@@ -67,12 +67,26 @@ from .engine import terminal_name as _terminal_name
 __all__ = ["RULES"]
 
 # Client-side call surface: method name -> index of the first PAYLOAD
-# argument (the endpoint name sits at index 1 for all three).
-_PAYLOAD_START = {"async_": 2, "sync": 2, "async_callback": 3}
+# argument (the endpoint name sits at index 1 for all of them;
+# call_with_deadline carries the budget at index 2, async_callback the
+# callback at index 2 — payload starts after those).
+_PAYLOAD_START = {"async_": 2, "sync": 2, "async_callback": 3,
+                  "call_with_deadline": 3}
 
-# Endpoints Rpc.__init__ auto-defines on every peer (the telemetry
-# export surface), resolvable at runtime regardless of lint-run scope.
-_BUILTIN_ENDPOINTS = ("__telemetry",)
+# Endpoints the library itself defines: the telemetry export surface
+# every Rpc auto-defines at construction, plus the serving tier's
+# ``{service}.*`` family (moolib_tpu/serving/replica.py registers them
+# from f-strings, so tools/tests lint runs — which do not see the
+# package's defines — must still resolve literal call sites like
+# ``"serve.health"``). The serving entries use the engine's WILDCARD
+# (the f-string-hole abstraction), matching any service prefix.
+_BUILTIN_ENDPOINTS = (
+    "__telemetry",
+    WILDCARD + ".infer",
+    WILDCARD + ".health",
+    WILDCARD + ".load",
+    WILDCARD + ".drain",
+)
 
 
 def _call_sites(
@@ -438,9 +452,11 @@ class RpcPayloadUnserializable(Rule):
 # -- future-origin timeout discipline ----------------------------------------
 
 #: Methods whose return value is an RPC-origin Future (Rpc.async_/
-#: async_callback, Group.all_reduce — the Accumulator's rounds flow
-#: through these same calls).
-_PRODUCER_METHODS = {"async_", "async_callback", "all_reduce"}
+#: async_callback/call_with_deadline, Group.all_reduce — the
+#: Accumulator's rounds flow through these same calls — and the serving
+#: Router's infer_async, whose executor future wraps an RPC wait).
+_PRODUCER_METHODS = {"async_", "async_callback", "call_with_deadline",
+                     "all_reduce", "infer_async"}
 
 
 def _producer_functions(ctx: ModuleContext) -> Set[str]:

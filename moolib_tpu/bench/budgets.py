@@ -90,6 +90,16 @@ CPU_PROXY_BUDGETS: Dict[str, Budget] = {
     # multiple GB/s measured.
     "serial_encode_gbps": Budget(value_min=0.1),
     "serial_decode_gbps": Budget(value_min=0.1),
+    # Serving closed loop (router + 2 replicas, 8 concurrent callers,
+    # batched jitted model): hundreds of req/s and ~tens-of-ms p99
+    # measured at smoke sizes — the floor/ceilings catch a wedged batch
+    # loop or dispatch path, not a slow host. The quantile ceiling reads
+    # the router's own request histogram off the attached snapshot.
+    "serving_qps": Budget(
+        value_min=5.0,
+        quantiles=[("serving_request_seconds", "", {"p99": 5.0})],
+    ),
+    "serving_p99_latency_s": Budget(value_max=5.0),
 }
 
 

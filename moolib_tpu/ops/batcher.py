@@ -26,7 +26,20 @@ import numpy as np
 from ..telemetry import global_telemetry
 from ..utils import nest
 
-__all__ = ["Batcher"]
+__all__ = ["Batcher", "stage_batch"]
+
+
+def stage_batch(batch: Any, device: Optional[Any]) -> Any:
+    """One-shot H2D staging of a completed batch: every leaf normalized to
+    a contiguous host array, then ONE ``jax.device_put`` for the whole
+    structure (not one per leaf). ``device=None`` is a no-op. Shared by
+    :class:`Batcher` and the serving replica's dynamic-batching loop —
+    both want the same "assemble on host, move once" contract."""
+    if device is None:
+        return batch
+    return jax.device_put(
+        jax.tree_util.tree_map(np.asarray, batch), device
+    )
 
 
 class _Slot:
@@ -163,6 +176,44 @@ class Batcher:
         # Stage the emitted batches outside the lock, in reserved order.
         for slot, raw in zip(slots, raws):
             self._fill(slot, self._stage(raw))
+
+    def flush(self) -> bool:
+        """Emit whatever is pending as a *partial* batch (leading dim <
+        ``batch_size``). Returns True when a batch was emitted, False when
+        nothing was pending.
+
+        The serving-style dynamic-batching primitive: a latency-bound
+        consumer that has waited its linger budget takes the short batch
+        now instead of holding requests hostage for a full one. Consumers
+        that rely on static shapes (jitted handlers) should pad the
+        result themselves or avoid flush()."""
+        with self._lock:
+            self._check_open()
+            if self._pending_stack:
+                items, self._pending_stack = self._pending_stack, []
+                slot = _Slot()
+                self._ready.append(slot)
+                self._record_emit_locked(1, len(items))
+                raw = None
+            elif self._pending_cat:
+                items = None
+                raw = (
+                    self._cat_trees(self._pending_cat)
+                    if len(self._pending_cat) > 1
+                    else self._pending_cat[0]
+                )
+                rows = self._pending_cat_rows
+                self._pending_cat = []
+                self._pending_cat_rows = 0
+                slot = _Slot()
+                self._ready.append(slot)
+                self._record_emit_locked(1, rows)
+            else:
+                return False
+        # Assemble + stage outside the lock (same contract as stack/cat).
+        batch = raw if items is None else self._stack_trees(items)
+        self._fill(slot, self._stage(batch))
+        return True
 
     # -- consumer side ------------------------------------------------------
 
@@ -336,9 +387,4 @@ class Batcher:
         """Dispatch H2D staging at batch-completion time (producer side), so
         the async transfer overlaps accumulation of the next batch and get()
         returns an already-staged jax.Array."""
-        if self.device is None:
-            return batch
-        # One batched device_put for the whole structure, not one per leaf.
-        return jax.device_put(
-            jax.tree_util.tree_map(np.asarray, batch), self.device
-        )
+        return stage_batch(batch, self.device)

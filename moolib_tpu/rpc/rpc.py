@@ -65,6 +65,17 @@ log = get_logger("rpc")
 # Cannot collide with user payloads: those are always 2-tuples.
 _TRACE_TAG = "__mtr__"
 
+# Wire sentinel for deadline propagation (the serving tier's router→replica
+# budget): a call made via ``Rpc.call_with_deadline`` wraps the payload as
+# (_DEADLINE_TAG, remaining_budget_seconds, payload). The budget is a
+# *relative* remaining allowance (never an absolute wall time — peer clocks
+# are not comparable); the receiver re-anchors it against its own monotonic
+# clock and exposes it to handlers (``respond.deadline`` /
+# ``RpcDeferredReturn.deadline`` / queue-entry expiry) so servers can shed
+# work whose budget cannot cover service. Nested INSIDE the trace wrap when
+# both apply. Cannot collide with user payloads: those are always 2-tuples.
+_DEADLINE_TAG = "__mdl__"
+
 __all__ = ["Rpc", "RpcError", "Future", "Queue", "RpcDeferredReturn"]
 
 # Control function ids (reference: ReqType words, src/rpc.h:94-108).
@@ -101,6 +112,42 @@ class RpcError(RuntimeError):
     pass
 
 
+def _check_wait_timeout(timeout, what: str):
+    """Validate a *wait* timeout (``Future.result``/``exception``).
+
+    The two documented sentinels are ``None`` (wait forever) and ``0``
+    (non-blocking poll: return/raise immediately — the accumulator and
+    group drain loops rely on it). Anything negative or non-finite is a
+    programming error, not a policy: silently treating ``-5`` or ``nan``
+    as "no wait" hides the bug at the call site. Returns the validated
+    value."""
+    if timeout is None:
+        return None
+    t = float(timeout)
+    if t < 0 or not math.isfinite(t):
+        raise ValueError(
+            f"{what}: timeout must be None (wait forever), 0 (poll), or a "
+            f"positive finite number of seconds, got {timeout!r}"
+        )
+    return t
+
+
+def _check_budget(seconds, what: str) -> float:
+    """Validate a *deadline* duration (``set_timeout``, per-call budgets).
+
+    These values feed the deadline wheel: ``0`` would expire every call
+    before its first send, ``inf``/``nan`` crash the wheel's slot
+    arithmetic (``int(inf / tick)`` raises) — both are undefined-behavior
+    territory, so they are rejected eagerly with a clear error."""
+    s = float(seconds)
+    if s <= 0 or not math.isfinite(s):
+        raise ValueError(
+            f"{what}: must be a positive finite number of seconds, "
+            f"got {seconds!r}"
+        )
+    return s
+
+
 class Future:
     """RPC future bridging threads and asyncio.
 
@@ -125,6 +172,7 @@ class Future:
     # -- public surface ------------------------------------------------------
 
     def result(self, timeout: Optional[float] = None):
+        timeout = _check_wait_timeout(timeout, "Future.result")
         try:
             return self._cf.result(timeout)
         except concurrent.futures.TimeoutError:
@@ -146,6 +194,7 @@ class Future:
         return self._cf.cancel()
 
     def exception(self, timeout: Optional[float] = None):
+        timeout = _check_wait_timeout(timeout, "Future.exception")
         try:
             return self._cf.exception(timeout)
         except concurrent.futures.TimeoutError:
@@ -162,11 +211,18 @@ class Future:
 
 class RpcDeferredReturn:
     """Handle for replying to a call outside the handler (reference:
-    src/rpc.h RpcDeferredReturn<T>, surfaced by define_deferred)."""
+    src/rpc.h RpcDeferredReturn<T>, surfaced by define_deferred).
+
+    When the caller propagated a deadline (``Rpc.call_with_deadline``),
+    ``deadline`` holds the receiver-side ``time.monotonic()`` instant the
+    caller's budget expires at and ``budget`` the propagated allowance in
+    seconds; both are ``None`` for plain calls."""
 
     def __init__(self, respond: Callable[[Any, Optional[str]], None]):
         self._respond = respond
         self._done = False
+        self.deadline: Optional[float] = getattr(respond, "deadline", None)
+        self.budget: Optional[float] = getattr(respond, "budget", None)
 
     def __call__(self, value=None):
         if self._done:
@@ -208,7 +264,7 @@ class Queue:
         self._closed = False
         self._async_waiters: List[Tuple[Any, Any]] = []  # (loop, event)
 
-    def _push(self, return_cb, args, kwargs):
+    def _push(self, return_cb, args, kwargs, deadline=None):
         # Locally-enqueued items have no caller deadline to honor — they
         # keep forever even on an RPC-bound queue (whose _timeout is the
         # RPC timeout; stamping _RAW entries with it would silently drop
@@ -217,6 +273,10 @@ class Queue:
             float("inf") if return_cb is self._RAW
             else time.monotonic() + self._timeout()
         )
+        if deadline is not None:
+            # Caller-propagated budget (call_with_deadline): the entry is
+            # worthless past it — expire at the earlier of the two.
+            expiry = min(expiry, deadline)
         with self._cond:
             self._entries.append((expiry, return_cb, args, kwargs))
             self._cond.notify_all()
@@ -237,10 +297,42 @@ class Queue:
         self._push(self._RAW, item, None)
 
     def _pop_locked(self):
-        """Drop expired entries, then pop up to batch_size live ones."""
+        """Expire stale entries, then pop up to batch_size live ones.
+
+        An expired RPC entry gets an explicit error reply instead of a
+        silent drop: for a deadline-stamped entry the caller is still
+        waiting (its budget just ran out of queue headroom) and a fast
+        ``DeadlineExceeded`` beats discovering the loss at the RPC
+        deadline; for a default-expiry entry the caller's future already
+        timed out, so the late reply is dropped client-side — harmless
+        either way, and the server's answered-ness bookkeeping stays
+        truthful (no rid parked forever in "still executing")."""
         now = time.monotonic()
-        while self._entries and self._entries[0][0] < now:
-            self._entries.popleft()  # expired: caller's future timed out
+        # Deadline-stamped entries (call_with_deadline) make expiries
+        # NON-monotone in arrival order — a short-budget entry can sit
+        # behind a long-lived head — so the sweep must walk the whole
+        # queue, not just the head. Entry counts are bounded by the
+        # server's admission/backpressure, so the scan is cheap.
+        if self._entries and any(e[0] < now for e in self._entries):
+            live: deque = deque()
+            for entry in self._entries:
+                if entry[0] >= now:
+                    live.append(entry)
+                    continue
+                _expiry, cb, _args, _kwargs = entry
+                if cb is self._RAW or not hasattr(cb, "error"):
+                    continue
+                try:
+                    cb.error(
+                        "DeadlineExceeded: request expired in the server "
+                        f"queue {self.name!r} before service"
+                    )
+                except (asyncio.CancelledError,
+                        concurrent.futures.CancelledError):
+                    raise  # never swallow task cancellation
+                except Exception:
+                    pass  # reply plumbing gone (conn down): nothing owed
+            self._entries = live
         if not self._entries:
             return None
         if self.batch_size is None:
@@ -508,7 +600,7 @@ class _Peer:
 class _Outgoing:
     __slots__ = ("rid", "peer_name", "fname", "frames", "future", "deadline",
                  "sent_at", "conn", "poked_at", "acked", "next_slot",
-                 "t0", "wall0", "trace_id")
+                 "t0", "wall0", "trace_id", "reroute")
 
     def __init__(self, rid, peer_name, fname, frames, future, deadline):
         self.rid = rid
@@ -530,6 +622,11 @@ class _Outgoing:
         self.t0 = self.sent_at
         self.wall0 = 0.0
         self.trace_id: Optional[str] = None
+        # False = fail fast on connection loss / unroutable peer instead
+        # of silently re-routing until the deadline: a serving router
+        # wants the error NOW so it can retry on a *different* replica
+        # (transport-level patience would eat the caller's whole budget).
+        self.reroute = True
 
 
 def _boot_id() -> str:
@@ -703,7 +800,7 @@ class Rpc:
         return self._name
 
     def set_timeout(self, seconds: float):
-        self._timeout = float(seconds)
+        self._timeout = _check_budget(seconds, "Rpc.set_timeout")
 
     def set_keepalive_interval(self, seconds: float):
         """Silence probe cadence; a connection that stays silent for 4
@@ -1017,6 +1114,17 @@ class Rpc:
     async def _resend_for(self, dead: _Conn):
         for out in list(self._outgoing.values()):
             if out.conn is dead and not out.future.done():
+                if not out.reroute:
+                    # Fail-fast contract (call_with_deadline): connection
+                    # loss is an explicit error NOW, not a silent re-route
+                    # — the caller owns failover and still has budget to
+                    # spend on a different peer.
+                    self._outgoing.pop(out.rid, None)
+                    out.future._set_exception(RpcError(
+                        f"connection to {out.peer_name} lost before reply "
+                        f"to {out.fname!r} (reroute disabled)"
+                    ))
+                    continue
                 if self.telemetry.on:
                     self._m_resends.inc()
                 try:
@@ -1207,6 +1315,14 @@ class Rpc:
         if (type(obj) is tuple and len(obj) == 3
                 and obj[0] == _TRACE_TAG):
             trace_id, obj = obj[1], obj[2]
+        # Deadline unwrap, same unconditional contract (nested inside the
+        # trace wrap when both ride): re-anchor the propagated remaining
+        # budget against OUR monotonic clock — wall clocks across peers
+        # are not comparable, relative budgets are.
+        budget = None
+        if (type(obj) is tuple and len(obj) == 3
+                and obj[0] == _DEADLINE_TAG):
+            budget, obj = float(obj[1]), obj[2]
         # Key by peer_id: a restarted peer reusing a name (and rids) must be
         # executed fresh, never served a previous incarnation's cache
         # (reference: PeerId-based identity, src/rpc.cc:455-487).
@@ -1294,6 +1410,13 @@ class Rpc:
             except RuntimeError:
                 pass  # Rpc closed while a handler was finishing: reply moot
 
+        if budget is not None:
+            # Handler-visible deadline surface: define_deferred exposes it
+            # as dr.deadline, define_queue stamps queue-entry expiry with
+            # it, and admission layers (serving) read it to shed work
+            # whose budget cannot cover service.
+            respond.budget = budget
+            respond.deadline = time.monotonic() + budget
         handler(respond, obj)
 
     def _mark_recent(self, key):
@@ -1493,7 +1616,10 @@ class Rpc:
                 respond(value, None)
 
             cb.error = lambda msg: respond(None, str(msg))
-            queue._push(cb, args, kwargs)
+            # Propagated caller deadline (call_with_deadline), if any:
+            # visible to queue consumers and bounds the entry's expiry.
+            cb.deadline = getattr(respond, "deadline", None)
+            queue._push(cb, args, kwargs, deadline=cb.deadline)
 
         self._functions[fid_for(name)] = (name, handler)
         return queue
@@ -1516,11 +1642,41 @@ class Rpc:
     # -- calls (client side) -------------------------------------------------
 
     def async_(self, peer: str, func: str, *args, **kwargs) -> Future:
+        return self._start_call(peer, func, args, kwargs, None, True)
+
+    def call_with_deadline(self, peer: str, func: str, budget_s: float,
+                           *args, reroute: bool = False,
+                           **kwargs) -> Future:
+        """Call ``func`` with a propagated per-request deadline.
+
+        ``budget_s`` (positive, finite) is the remaining time allowance:
+        it caps this call's own expiry at ``min(budget_s, set_timeout)``
+        AND rides the wire (see ``_DEADLINE_TAG``) so the receiving peer
+        can shed the work when the budget can no longer cover its service
+        time (``respond.deadline``/``RpcDeferredReturn.deadline``, queue
+        entries expire at the propagated instant). Note the budget is
+        stamped into the frames at submission — a reconnect resend reuses
+        the stamp, so a receiver after a resend sees a slightly generous
+        remaining budget; the caller-side expiry is exact regardless.
+
+        ``reroute=False`` (the default here, unlike ``async_``) makes the
+        call fail fast with an explicit error when the connection to the
+        peer dies or the peer is unroutable, instead of silently
+        re-routing/redialing until the deadline: failover to a different
+        peer is the caller's decision (the serving router retries
+        elsewhere with the budget that is still left)."""
+        budget = _check_budget(budget_s, "Rpc.call_with_deadline")
+        return self._start_call(peer, func, args, kwargs, budget, reroute)
+
+    def _start_call(self, peer: str, func: str, args, kwargs,
+                    budget: Optional[float], reroute: bool) -> Future:
         fut = Future()
         rid = (next(self._rid_counter) << 1) | 1
         log.debug("%s: call %s::%s rid=%d", self._name, peer, func, rid)
         tel = self.telemetry
         payload: Any = (args, kwargs)
+        if budget is not None:
+            payload = (_DEADLINE_TAG, budget, payload)
         trace_id = None
         if tel.tracing:
             # Trace-id propagation: ride the payload (see _TRACE_TAG);
@@ -1539,8 +1695,11 @@ class Rpc:
                 self._tel_client[func] = cm
             cm[0].inc()
         frames = serial.serialize(rid, fid_for(func), payload)
+        expiry = self._timeout if budget is None \
+            else min(self._timeout, budget)
         out = _Outgoing(rid, peer, func, frames, fut,
-                        time.monotonic() + self._timeout)
+                        time.monotonic() + expiry)
+        out.reroute = reroute
         if trace_id is not None:
             out.trace_id = trace_id
             out.wall0 = time.time()
@@ -1711,6 +1870,19 @@ class Rpc:
                         continue
                     if out.conn is None:
                         await self._send_out(out)
+                        if out.conn is None and not out.reroute:
+                            # Fail-fast contract: the peer is unroutable
+                            # (no live conn and the re-route attempt just
+                            # failed) — error now instead of redialing
+                            # until the deadline. The first wheel check is
+                            # one tick after submission, so a dial racing
+                            # the call still gets that window to land.
+                            self._outgoing.pop(rid, None)
+                            out.future._set_exception(RpcError(
+                                f"no route to {out.peer_name} for "
+                                f"{out.fname!r} (reroute disabled)"
+                            ))
+                            continue
                     elif not out.acked:
                         # Unanswered and un-acked: poke the server after a
                         # latency-scaled silence so a request lost in a

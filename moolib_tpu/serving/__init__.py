@@ -1,0 +1,53 @@
+"""Fault-tolerant serving tier: replicated low-latency inference.
+
+The north star's "millions of users" half of the reliability story
+(ROADMAP item 3): N :class:`Replica` peers do admission-controlled
+dynamic batching of ``infer`` calls (inside jit, with static-shape
+padding), a :class:`Router` dispatches load-aware off scraped health
+gauges and fails over across replicas, and the robustness layer keeps
+p99 bounded while things die:
+
+- per-request deadlines propagate router -> replica on the wire
+  (:meth:`~moolib_tpu.rpc.Rpc.call_with_deadline`); replicas shed work
+  whose remaining budget cannot cover their observed p50 service time;
+- bounded admission queues refuse with explicit :class:`Overloaded`
+  errors instead of growing silently;
+- the router retries *safe* failures (idempotent + budget remaining) on
+  a different replica with capped-exponential jittered backoff;
+- health-gated routing: K missed probes or a tripped failure-rate
+  :class:`~moolib_tpu.serving.health.CircuitBreaker` drains a replica
+  from rotation until it proves itself again;
+- graceful drain finishes admitted work before a replica departs, and
+  hot model swaps (:meth:`Router.publish_weights`, fed from a training
+  Accumulator via :func:`publish_from_accumulator`) never drop in-flight
+  requests.
+
+See ``docs/serving.md`` for the architecture and failure model, and
+``moolib_tpu/testing/scenarios.py`` for the chaos scenarios that pin the
+guarantees (replica kill mid-load, router partition).
+"""
+
+from .admission import (
+    AdmissionQueue,
+    DeadlineExceeded,
+    Overloaded,
+    ServingError,
+    error_kind,
+)
+from .health import CircuitBreaker, ReplicaHealth
+from .replica import ENDPOINT_SUFFIXES, Replica
+from .router import Router, publish_from_accumulator
+
+__all__ = [
+    "AdmissionQueue",
+    "CircuitBreaker",
+    "DeadlineExceeded",
+    "ENDPOINT_SUFFIXES",
+    "Overloaded",
+    "Replica",
+    "ReplicaHealth",
+    "Router",
+    "ServingError",
+    "error_kind",
+    "publish_from_accumulator",
+]

@@ -1,0 +1,300 @@
+"""Model replica server: admission-controlled dynamic batching of
+``infer`` calls, health/load export, hot model swap, graceful drain.
+
+One :class:`Replica` owns four endpoints on its :class:`~moolib_tpu.rpc.Rpc`
+(names are ``{service}.*`` so several services can share a peer):
+
+- ``{service}.infer(x)`` — admission (bounded queue, deadline shed,
+  ``Overloaded``/``DeadlineExceeded`` refusals as explicit errors), then
+  dynamic batching: a worker thread coalesces admitted requests (up to
+  ``batch_size``, with a short linger), stacks them with the same
+  ``nest`` machinery the RPC batched-define path uses, optionally pads
+  to a static shape so a jitted model compiles once, stages to a device
+  via :func:`~moolib_tpu.ops.batcher.stage_batch`, runs
+  ``model_fn(params, batch)``, and unbatches the replies.
+- ``{service}.health()`` — the router's probe: inflight/queue/latency
+  read from this peer's telemetry plus ``draining`` and
+  ``model_version`` (the "scraped gauges" dispatch ranks on).
+- ``{service}.load(params, version)`` — hot model swap: the new bundle
+  becomes visible at the next batch boundary; the in-flight batch keeps
+  the params it captured, so no admitted request is dropped by a swap.
+- ``{service}.drain()`` — graceful departure: stop admitting, finish
+  admitted work, then reply (the caller may then close the peer).
+
+Per-request deadlines arrive via the RPC deadline metadata
+(``Rpc.call_with_deadline`` -> ``RpcDeferredReturn.deadline``); the
+replica sheds work whose remaining budget cannot cover its observed p50
+service time — at admission AND again at batch-pop, so budget burned in
+the queue is honored.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..ops.batcher import stage_batch
+from ..rpc import Rpc, RpcError
+from ..telemetry import FRACTION_EDGES
+from ..utils import get_logger, nest
+from .admission import AdmissionQueue, DeadlineExceeded, Overloaded
+
+__all__ = ["Replica", "ENDPOINT_SUFFIXES"]
+
+log = get_logger("serving")
+
+#: The endpoint family a Replica registers: ``{service}.{suffix}``.
+ENDPOINT_SUFFIXES = ("infer", "health", "load", "drain")
+
+
+class Replica:
+    """A serving replica on an existing ``Rpc`` peer.
+
+    ``model_fn(params, batch)`` maps a leading-batch-dim structure to a
+    leading-batch-dim structure; wrap it in ``jax.jit`` and pass
+    ``pad=True`` for compile-once static shapes. ``params`` is an
+    arbitrary (picklable) tree, hot-swappable via ``load``.
+    """
+
+    def __init__(self, rpc: Rpc, model_fn: Callable[[Any, Any], Any],
+                 params: Any = None, *, version: int = 0,
+                 service: str = "serve", batch_size: int = 8,
+                 max_queue: int = 64, linger_s: float = 0.002,
+                 device: Optional[Any] = None, pad: bool = False,
+                 shed_safety: float = 1.0):
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size!r}")
+        for suffix in ENDPOINT_SUFFIXES:
+            name = f"{service}.{suffix}"
+            if rpc.defined(name):
+                # Runtime mirror of moolint's rpc-define-collision (the
+                # EnvPoolServer/Accumulator contract): a silent re-define
+                # would clobber another service's handlers.
+                raise RpcError(
+                    f"endpoint {name!r} is already defined on this Rpc: "
+                    "another Replica (or service) with the same service "
+                    "name is registered; pick a distinct service="
+                )
+        self.rpc = rpc
+        self.service = service
+        self.batch_size = int(batch_size)
+        self.linger_s = float(linger_s)
+        self.device = device
+        self.pad = bool(pad)
+        self._model_fn = model_fn
+        self._model_lock = threading.Lock()
+        self._params = params
+        self._version = int(version)
+        self._closed = False
+        self._stop = threading.Event()
+
+        tel = rpc.telemetry
+        reg = tel.registry
+        self._tel = tel
+        self.admission = AdmissionQueue(
+            max_queue, service=service, peer=rpc.get_name(),
+            telemetry=tel, shed_safety=shed_safety,
+        )
+        self._m_batches = reg.counter("serving_batches_total",
+                                      service=service)
+        self._m_rows = reg.counter("serving_batch_rows_total",
+                                   service=service)
+        self._m_fill = reg.histogram("serving_batch_fill_fraction",
+                                     edges=FRACTION_EDGES, service=service)
+        self._m_version = reg.gauge("serving_model_version", service=service)
+        self._m_version.set(float(self._version))
+        # Weakref inflight gauge (the shared-registry lifetime contract).
+        # Peer-labelled so two same-service replicas sharing one
+        # Telemetry never replace or cross-unregister each other's
+        # series (the Rpc inflight/peers gauge rule).
+        wself = weakref.ref(self)
+        reg.gauge_fn("serving_inflight",
+                     lambda: wself().admission.inflight, service=service,
+                     peer=rpc.get_name())
+
+        rpc.define_deferred(f"{service}.infer", self._on_infer)
+        rpc.define(f"{service}.health", self.health)
+        rpc.define(f"{service}.load", self._on_load)
+        rpc.define_deferred(f"{service}.drain", self._on_drain)
+
+        self._worker = threading.Thread(
+            target=self._serve_loop,
+            name=f"{rpc.get_name()}-{service}-serve", daemon=True,
+        )
+        self._worker.start()
+
+    # -- endpoint handlers ---------------------------------------------------
+
+    def _on_infer(self, dr, x):
+        try:
+            self.admission.admit((dr, x), deadline=dr.deadline)
+        except Overloaded as e:
+            dr.error(f"Overloaded: {e}")
+        except DeadlineExceeded as e:
+            dr.error(f"DeadlineExceeded: {e}")
+
+    def health(self) -> Dict[str, Any]:
+        """Load/liveness snapshot for the router's probe — served off the
+        admission state and the telemetry estimators, cheap enough to
+        answer under full load (it never touches the model lock)."""
+        adm = self.admission
+        return {
+            "name": self.rpc.get_name(),
+            "service": self.service,
+            "inflight": adm.inflight,
+            "queue_depth": adm.depth,
+            "capacity": adm.capacity,
+            "p50_service_s": adm.service_p50(),
+            "draining": adm.draining,
+            "model_version": self._version,
+            "batch_size": self.batch_size,
+        }
+
+    def _on_load(self, params, version):
+        with self._model_lock:
+            self._params = params
+            self._version = int(version)
+        self._m_version.set(float(version))
+        log.info("%s/%s: model swapped to version %s",
+                 self.rpc.get_name(), self.service, version)
+        return int(version)
+
+    def _on_drain(self, dr):
+        ok = self.drain(timeout=60.0)
+        dr({"drained": bool(ok), "name": self.rpc.get_name()})
+
+    # -- model management (local surface) ------------------------------------
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def set_model(self, params: Any, version: int) -> None:
+        """Local equivalent of the ``load`` endpoint."""
+        self._on_load(params, version)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful departure: refuse new admissions, serve out what was
+        admitted, return True once nothing is queued or in flight."""
+        return self.admission.drain(timeout=timeout)
+
+    # -- the batch loop ------------------------------------------------------
+
+    def _serve_loop(self):
+        while not self._stop.is_set():
+            try:
+                serve, shed = self.admission.get_batch(
+                    self.batch_size, timeout=0.1, linger=self.linger_s
+                )
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # never swallow task cancellation
+            except Exception as e:
+                log.error("serve loop pop failed: %s", e)
+                continue
+            if shed:
+                for dr, _x in shed:
+                    self._reply_error(
+                        dr,
+                        "DeadlineExceeded: remaining budget cannot cover "
+                        "the observed p50 service time (shed in queue)",
+                    )
+                self.admission.fail(len(shed), shed=True)
+            if not serve:
+                continue
+            self._run_batch(serve)
+
+    def _run_batch(self, serve):
+        n = len(serve)
+        t0 = time.monotonic()
+        with self._model_lock:
+            params = self._params
+        xs = [x for _dr, x in serve]
+        try:
+            batch = nest.stack_fields(xs)
+            if self.pad and n < self.batch_size:
+                # Static-shape padding (the RPC batched-define trick):
+                # repeat row 0 so a jitted model compiles once, slice the
+                # reply back to the real rows.
+                def _pad(x):
+                    return np.concatenate(
+                        [x, np.repeat(np.asarray(x[:1]),
+                                      self.batch_size - n, axis=0)]
+                    )
+
+                batch = nest.map_structure(_pad, batch)
+            batch = stage_batch(batch, self.device)
+            out = self._model_fn(params, batch)
+            out = nest.map_structure(np.asarray, out)
+            if self.pad and n < self.batch_size:
+                out = nest.slice_fields(out, 0, n)
+            results = nest.unstack_fields(out, n)
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            # Fail the whole batch to its callers, then propagate.
+            for dr, _x in serve:
+                self._reply_error(dr, "CancelledError: batch cancelled")
+            self.admission.fail(n)
+            raise
+        except Exception as e:
+            log.error("%s/%s: model batch failed: %s",
+                      self.rpc.get_name(), self.service, e)
+            for dr, _x in serve:
+                self._reply_error(dr, f"{type(e).__name__}: {e}")
+            self.admission.fail(n)
+            return
+        dt = time.monotonic() - t0
+        for (dr, _x), r in zip(serve, results):
+            self._reply(dr, r)
+        self.admission.done(n, dt / n)
+        if self._tel.on:
+            self._m_batches.inc()
+            self._m_rows.inc(n)
+            self._m_fill.observe(n / self.batch_size)
+
+    @staticmethod
+    def _reply(dr, value):
+        try:
+            dr(value)
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            raise  # never swallow task cancellation
+        except Exception as e:
+            log.debug("reply dropped: %s", e)
+
+    @staticmethod
+    def _reply_error(dr, msg):
+        try:
+            dr.error(msg)
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            raise  # never swallow task cancellation
+        except Exception as e:
+            log.debug("error reply dropped: %s", e)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Hard stop: undefine the endpoint family, stop the batch loop,
+        unregister this replica's gauges. For a graceful departure call
+        :meth:`drain` first."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        for suffix in ENDPOINT_SUFFIXES:
+            self.rpc.undefine(f"{self.service}.{suffix}")
+        self.admission.close()
+        self._worker.join(timeout=5)
+        reg = self.rpc.telemetry.registry
+        reg.unregister("serving_inflight", service=self.service,
+                       peer=self.rpc.get_name())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

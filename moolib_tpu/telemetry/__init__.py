@@ -42,6 +42,7 @@ from .registry import (
     Gauge,
     Histogram,
     Registry,
+    RollingQuantile,
     parse_prometheus,
     quantile_from_export,
 )
@@ -53,6 +54,7 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "RollingQuantile",
     "TraceBuffer",
     "Span",
     "DEFAULT_TIME_EDGES",

@@ -12,6 +12,8 @@ plan's injected-event summary. Replaying a failure needs only the seed
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
 import threading
 import time
 from typing import Dict
@@ -25,9 +27,12 @@ from .chaos import ChaosNet, FaultPlan
 
 __all__ = [
     "MiniCluster",
+    "ServingFleet",
     "scenario_drop_storm",
     "scenario_partition_heal",
     "scenario_leader_loss",
+    "scenario_replica_kill",
+    "scenario_router_partition",
     "SCENARIOS",
 ]
 
@@ -265,8 +270,353 @@ def scenario_leader_loss(seed: int) -> Dict[str, int]:
         cluster.close()
 
 
+# -- serving tier ------------------------------------------------------------
+
+
+class ServingFleet:
+    """Router + N replica peers, all in-process over loopback on
+    OS-assigned ports — the canonical serving cohort for the chaos
+    scenarios, the CI smoke, and ``tools/serving_load.py``.
+
+    The model is a trivial numpy scale (``x * params["scale"]``) so the
+    scenarios measure the serving machinery, not arithmetic; the jitted/
+    padded path is pinned separately in ``tests/test_serving.py``."""
+
+    def __init__(self, n_replicas: int = 3, *, service: str = "serve",
+                 batch_size: int = 4, max_queue: int = 128,
+                 attempt_timeout_s: float = 1.0,
+                 probe_interval_s: float = 0.1, probe_misses: int = 3,
+                 seed: int = 0):
+        from ..serving import Replica, Router
+
+        self.service = service
+        self.replicas = []
+        self.replica_rpcs = []
+        params = {"scale": np.float32(2.0)}
+        model = lambda p, x: x * p["scale"]  # noqa: E731
+        for i in range(n_replicas):
+            rpc = Rpc(f"rep{i}")
+            rpc.listen("127.0.0.1:0")
+            rep = Replica(rpc, model, params, version=1, service=service,
+                          batch_size=batch_size, max_queue=max_queue)
+            self.replica_rpcs.append(rpc)
+            self.replicas.append(rep)
+        self.router_rpc = Rpc("router")
+        for rpc in self.replica_rpcs:
+            self.router_rpc.connect(rpc.debug_info()["listen"][0])
+        self.router = Router(
+            self.router_rpc, [r.get_name() for r in self.replica_rpcs],
+            service=service, attempt_timeout_s=attempt_timeout_s,
+            probe_interval_s=probe_interval_s, probe_misses=probe_misses,
+            seed=seed,
+        )
+
+    def all_rpcs(self):
+        return [self.router_rpc] + list(self.replica_rpcs)
+
+    def wait_routable(self, n: int, timeout: float = 15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.router.routable()) >= n:
+                return
+            time.sleep(0.02)
+        raise AssertionError(
+            f"fleet never reached {n} routable replicas: "
+            + str(self.router.stats())
+        )
+
+    def close(self):
+        self.router.close()
+        self.router_rpc.close()
+        for rep, rpc in zip(self.replicas, self.replica_rpcs):
+            # Idempotent: scenarios may have closed a killed replica.
+            rep.close()
+            rpc.close()
+
+
+def _run_load(router, n_requests: int, concurrency: int,
+              budget_s: float, outcomes: list, lock: threading.Lock,
+              on_count=None):
+    """Drive ``n_requests`` through ``router`` from ``concurrency``
+    threads; every outcome (ok latency or explicit error) is recorded —
+    a request that neither returns nor raises within budget+slack would
+    hang its worker and fail the join assertion in the scenario."""
+    from ..serving import error_kind
+
+    per = [n_requests // concurrency] * concurrency
+    for i in range(n_requests % concurrency):
+        per[i] += 1
+    counter = {"n": 0}
+
+    def worker(k):
+        x = np.ones(4, np.float32)
+        for _ in range(per[k]):
+            t0 = time.monotonic()
+            try:
+                out = router.infer(x, budget_s=budget_s)
+                rec = ("ok", time.monotonic() - t0, float(out[0]))
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # never swallow task cancellation
+            except Exception as e:
+                rec = ("err", time.monotonic() - t0,
+                       f"{error_kind(e)}: {e}")
+            with lock:
+                outcomes.append(rec)
+                counter["n"] += 1
+                n = counter["n"]
+            if on_count is not None:
+                on_count(n)
+
+    threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+               for k in range(concurrency)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def _p99(latencies):
+    if not latencies:
+        return None
+    vals = sorted(latencies)
+    return vals[min(int(0.99 * len(vals)), len(vals) - 1)]
+
+
+def scenario_replica_kill(seed: int, *, pre_requests: int = 60,
+                          post_requests: int = 90,
+                          concurrency: int = 4,
+                          budget_s: float = 8.0) -> Dict[str, int]:
+    """Kill one of three replicas mid-load (the ROADMAP item-3
+    acceptance): every accepted request completes or fails fast with an
+    explicit error (no hang to the RPC deadline), served p99 stays
+    within 3x the pre-kill p99 (floored at the transport's 100ms
+    failure-detection tick so a quiet-host baseline cannot flake the
+    bound), the injected-event log
+    is identical for identical seeds (the only injections are scripted),
+    and the serving metric family is consistent with the observed
+    counts — checked in-registry AND through a live ``__telemetry``
+    wire scrape of a surviving replica."""
+    fleet = ServingFleet(3, seed=seed)
+    plan = FaultPlan(seed)
+    net = ChaosNet(plan, fleet.all_rpcs())
+    lock = threading.Lock()
+    try:
+        fleet.wait_routable(3)
+        # Pre-kill phase: a clean baseline under the same concurrency.
+        pre: list = []
+        for t in _run_load(fleet.router, pre_requests, concurrency,
+                           budget_s, pre, lock):
+            t.join(timeout=60)
+            assert not t.is_alive(), "pre-kill load worker hung"
+        assert all(k == "ok" for k, _lat, _v in pre), (
+            f"pre-kill phase had failures: "
+            f"{[r for r in pre if r[0] != 'ok'][:3]}"
+        )
+        p99_pre = _p99([lat for _k, lat, _v in pre])
+
+        # Post phase: kill rep0 after ~1/6 of the load has completed.
+        post: list = []
+        killed = threading.Event()
+
+        def maybe_kill(n):
+            if n >= post_requests // 6 and not killed.is_set():
+                killed.set()
+                net.kill_conns(fleet.replica_rpcs[0])
+                fleet.replica_rpcs[0].close()
+
+        threads = _run_load(fleet.router, post_requests, concurrency,
+                            budget_s, post, lock, on_count=maybe_kill)
+        for t in threads:
+            # budget + slack bounds every worker: a hang here means a
+            # request neither completed nor failed fast.
+            t.join(timeout=post_requests * (budget_s + 5))
+            assert not t.is_alive(), (
+                "post-kill load worker hung: an accepted request neither "
+                "completed nor failed fast"
+            )
+        assert killed.is_set(), "load finished before the kill landed"
+        assert len(post) == post_requests, (
+            f"accepted-then-dropped: {post_requests - len(post)} requests "
+            "vanished without an outcome"
+        )
+        # Every failure must be explicit AND fast (well under the 30s
+        # RPC deadline — bounded by the request budget plus slack).
+        for k, lat, detail in post:
+            assert lat < budget_s + 5.0, (
+                f"outcome took {lat:.1f}s (> budget {budget_s}s + slack): "
+                f"{detail}"
+            )
+        ok_lat = [lat for k, lat, _v in post if k == "ok"]
+        n_err = sum(1 for k, _lat, _v in post if k == "err")
+        assert len(ok_lat) >= post_requests * 0.8, (
+            f"only {len(ok_lat)}/{post_requests} requests served across "
+            f"the kill; errors: "
+            f"{[r[2] for r in post if r[0] == 'err'][:5]}"
+        )
+        p99_post = _p99(ok_lat)
+        # Floor the baseline at the transport's failure-detection
+        # granularity (one 100ms timeout-wheel tick): a rescued request
+        # structurally pays detection + one retry (~0.15s), and a
+        # sub-millisecond quiet-host baseline must not flake the bound
+        # into measuring the wheel instead of the serving tier.
+        bound = 3.0 * max(p99_pre, 0.1)
+        assert p99_post <= bound, (
+            f"served p99 blew out across the kill: pre={p99_pre:.4f}s "
+            f"post={p99_post:.4f}s (bound {bound:.4f}s)"
+        )
+        # Replay determinism: the only injections are scripted, so the
+        # log for a given seed is exactly this, every run.
+        assert [e.kind for e in plan.events] == ["conn_kill"], (
+            f"unexpected injected-event log: {plan.events}"
+        )
+
+        # Serving metric family consistent with the observed counts.
+        n_ok = len(ok_lat) + len(pre)
+        rreg = fleet.router_rpc.telemetry.registry
+        got_req = rreg.value("serving_router_requests_total",
+                             service=fleet.service)
+        got_ok = rreg.value("serving_router_ok_total", service=fleet.service)
+        assert got_req == pre_requests + post_requests, got_req
+        assert got_ok == n_ok, (got_ok, n_ok)
+        retried = rreg.value("serving_retried_total",
+                             service=fleet.service) or 0
+        admitted = sum(
+            rpc.telemetry.registry.value("serving_admitted_total",
+                                         service=fleet.service) or 0
+            for rpc in fleet.replica_rpcs[1:]
+        )
+        # Survivors admitted at least every request they served; the
+        # dead replica's registry died with it, so only bound below.
+        completed = sum(
+            rpc.telemetry.registry.value("serving_completed_total",
+                                         service=fleet.service) or 0
+            for rpc in fleet.replica_rpcs[1:]
+        )
+        assert admitted >= completed and completed <= n_ok + retried, (
+            admitted, completed, n_ok, retried,
+        )
+        # The family is visible through the wire scrape any peer serves.
+        scrape = fleet.router_rpc.sync(
+            fleet.replica_rpcs[1].get_name(), "__telemetry",
+            fmt="prometheus",
+        )
+        for metric in ("serving_admitted_total", "serving_completed_total",
+                       "serving_queue_depth", "serving_service_seconds"):
+            assert metric in scrape, f"{metric} missing from wire scrape"
+        plan.verify_telemetry()  # registry counters == injected log
+        return plan.summary()
+    finally:
+        net.detach_all()
+        fleet.close()
+
+
+def scenario_router_partition(seed: int, *, budget_s: float = 8.0,
+                              concurrency: int = 3) -> Dict[str, int]:
+    """Partition the router from one replica mid-load: health probes go
+    dark, the replica is drained from rotation (no accepted request is
+    dropped — victims fail fast at the attempt timeout and are retried
+    on healthy replicas), and after heal the replica returns to
+    rotation. Patterned drops depend on live timing, so this scenario
+    asserts invariants plus decision-level telemetry consistency, not an
+    exact log (docs/reliability.md)."""
+    fleet = ServingFleet(3, seed=seed, attempt_timeout_s=0.5)
+    plan = FaultPlan(seed)
+    net = ChaosNet(plan, fleet.all_rpcs())
+    lock = threading.Lock()
+    outcomes: list = []
+    stop = threading.Event()
+    try:
+        fleet.wait_routable(3)
+        target = fleet.replica_rpcs[0].get_name()
+
+        def worker():
+            x = np.ones(4, np.float32)
+            from ..serving import error_kind
+
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    fleet.router.infer(x, budget_s=budget_s)
+                    rec = ("ok", time.monotonic() - t0, "")
+                except (asyncio.CancelledError,
+                        concurrent.futures.CancelledError):
+                    raise  # never swallow task cancellation
+                except Exception as e:
+                    rec = ("err", time.monotonic() - t0,
+                           f"{error_kind(e)}: {e}")
+                with lock:
+                    outcomes.append(rec)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        _await(lambda: len(outcomes) >= 10, 30,
+               "load never got going", lock)
+
+        net.partition("router", target)
+        _await(lambda: target not in fleet.router.routable(), 15,
+               f"{target} never left rotation under partition")
+        with lock:
+            mark = len(outcomes)
+        # Served THROUGH the partition: the healthy replicas carry it.
+        _await(lambda: _count_ok(outcomes, lock, mark) >= 10, 30,
+               "no requests served while partitioned")
+
+        net.heal("router", target)
+        _await(lambda: target in fleet.router.routable(), 30,
+               f"{target} never returned to rotation after heal")
+        stop.set()
+        for t in threads:
+            t.join(timeout=budget_s + 10)
+            assert not t.is_alive(), "load worker hung"
+
+        for k, lat, detail in outcomes:
+            assert lat < budget_s + 5.0, (
+                f"outcome took {lat:.1f}s: {detail}"
+            )
+        n_ok = sum(1 for k, _l, _d in outcomes if k == "ok")
+        assert n_ok >= len(outcomes) * 0.5, (
+            f"partition starved the fleet: {n_ok}/{len(outcomes)} ok"
+        )
+        kinds = {e.kind for e in plan.events}
+        assert "partition" in kinds and "partitioned" in kinds, kinds
+        rreg = fleet.router_rpc.telemetry.registry
+        assert rreg.value("serving_probe_misses_total",
+                          service=fleet.service) >= 3, (
+            "partition never cost a probe"
+        )
+        plan.verify_telemetry()  # registry counters == injected log
+        return plan.summary()
+    finally:
+        stop.set()
+        net.detach_all()
+        fleet.close()
+
+
+def _count_ok(outcomes, lock, start):
+    with lock:
+        return sum(1 for k, _l, _d in outcomes[start:] if k == "ok")
+
+
+def _await(cond, timeout, what, lock=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if (cond() if lock is None else _locked_cond(cond, lock)):
+            return
+        time.sleep(0.02)
+    raise AssertionError(what)
+
+
+def _locked_cond(cond, lock):
+    with lock:
+        return cond()
+
+
 SCENARIOS = {
     "drop_storm": scenario_drop_storm,
     "partition_heal": scenario_partition_heal,
     "leader_loss": scenario_leader_loss,
+    "replica_kill": scenario_replica_kill,
+    "router_partition": scenario_router_partition,
 }

@@ -210,3 +210,35 @@ def test_cat_remainder_keeps_fill_histogram_recording(rng):
     assert global_telemetry().registry.value(
         "batcher_batches_total", batcher=name
     ) == 3.0
+
+
+def test_flush_emits_partial_stack_batch():
+    """flush(): the serving-style linger primitive — whatever is pending
+    becomes a short batch now; an empty batcher flushes to nothing."""
+    from moolib_tpu.ops.batcher import Batcher
+
+    b = Batcher(8, name="flush_stack")
+    assert b.flush() is False  # nothing pending
+    for i in range(3):
+        b.stack({"x": np.full(2, i, np.float32)})
+    assert b.empty()  # 3 < 8: no full batch yet
+    assert b.flush() is True
+    out = b.get(timeout=5)
+    assert out["x"].shape == (3, 2)
+    np.testing.assert_allclose(out["x"][:, 0], [0.0, 1.0, 2.0])
+    assert b.flush() is False  # pending consumed
+    b.close()
+
+
+def test_flush_emits_partial_cat_batch():
+    from moolib_tpu.ops.batcher import Batcher
+
+    b = Batcher(8, name="flush_cat")
+    b.cat({"x": np.zeros((2, 3), np.float32)})
+    b.cat({"x": np.ones((3, 3), np.float32)})
+    assert b.flush() is True
+    out = b.get(timeout=5)
+    assert out["x"].shape == (5, 3)
+    np.testing.assert_allclose(out["x"][:2], 0.0)
+    np.testing.assert_allclose(out["x"][2:], 1.0)
+    b.close()

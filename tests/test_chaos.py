@@ -419,3 +419,89 @@ def test_chaos_leader_loss_errors_futures_and_reelects():
 
     summary = scenario_leader_loss(seed=47)
     assert summary.get("conn_kill", 0) == 1, summary
+
+
+def test_chaos_serving_replica_kill_scenario():
+    """ISSUE 8 acceptance: with a seeded FaultPlan killing one of three
+    replicas mid-load, every accepted request completes or fails fast
+    with an explicit error (no hang to the RPC deadline), served p99
+    stays within 3x the pre-kill p99, the injected-event log is
+    deterministic for the seed, and the serving metric family
+    (admitted/shed/retried/drained, per-replica inflight + latency
+    histograms) is consistent with the scenario's counts — including
+    through a live __telemetry wire scrape. Canonical implementation
+    shared with the CI smoke stage (moolib_tpu.testing.scenarios)."""
+    from moolib_tpu.testing.scenarios import scenario_replica_kill
+
+    summary = scenario_replica_kill(seed=101)
+    assert summary == {"conn_kill": 1}, summary
+
+
+def test_chaos_serving_router_partition_scenario():
+    """Router partitioned from one replica mid-load: health probes go
+    dark, the replica is drained from rotation (victims fail fast at the
+    attempt timeout and are retried on healthy replicas — zero
+    accepted-then-dropped), and after heal it returns to rotation.
+    Canonical implementation shared with the CI smoke stage."""
+    from moolib_tpu.testing.scenarios import scenario_router_partition
+
+    summary = scenario_router_partition(seed=202)
+    assert summary.get("partition") == 2, summary  # start + heal
+    assert summary.get("partitioned", 0) >= 1, summary
+
+
+def test_chaos_batched_define_conn_kill_no_slot_leak():
+    """ISSUE 8 satellite: audit of the PR-5 response-cache suspicion
+    that a kill_conns landing between a batched-define enqueue and its
+    reply leaks the batch slot in _batched_server_loop. The audit found
+    no leak — the reply is cached for poke-driven replay, the resent
+    rids are duplicate-suppressed against the entries still queued, and
+    the queue drains — and this test pins exactly that window under a
+    seeded FaultPlan: the kill lands while batch 1 is mid-service and
+    batch 2 is still enqueued."""
+    host = Rpc("bhost")
+    host.listen("127.0.0.1:0")
+    executed = []
+    lock = threading.Lock()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def batched(x):
+        with lock:
+            executed.extend(np.asarray(x).reshape(-1).tolist())
+        entered.set()
+        release.wait(10)  # hold the reply open: the kill lands here
+        return x * 2
+
+    host.define("bwork", batched, batch_size=4)
+    client = Rpc("bclient")
+    client._poke_min = 0.2
+    client.set_timeout(15.0)
+    client.connect(host.debug_info()["listen"][0])
+    plan = FaultPlan(seed=131)
+    net = ChaosNet(plan, [client, host])
+    try:
+        futs = [client.async_("bhost", "bwork", np.float32(i))
+                for i in range(8)]
+        assert entered.wait(10), "batch worker never picked up the batch"
+        net.kill_conns(host, "bclient")  # between enqueue and reply
+        release.set()
+        for i, f in enumerate(futs):
+            np.testing.assert_allclose(f.result(timeout=30), 2.0 * i)
+        with lock:
+            assert sorted(executed) == [float(i) for i in range(8)], (
+                f"exactly-once violated: {sorted(executed)}"
+            )
+        # No leaked batch slot: the queue fully drained...
+        q = host._queues["bwork"]
+        with q._cond:
+            assert not q._entries, "batch queue entry leaked"
+        # ...and no rid is parked as "still executing" (answered-ness
+        # flipped for every request, so a late poke replays, not hangs).
+        assert all(host._recent_rids.values()), host._recent_rids
+        assert [e.kind for e in plan.events] == ["conn_kill"], plan.events
+        plan.verify_telemetry()
+    finally:
+        net.detach_all()
+        client.close()
+        host.close()

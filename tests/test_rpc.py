@@ -295,3 +295,46 @@ def test_transport_bandit_explores():
         picks[id(rpc_mod._best_conn(peer))] += 1
     assert picks[id(slow)] > 0  # exploration happens
     assert picks[id(fast)] > picks[id(slow)] * 10  # argmin dominates
+
+
+def test_future_timeout_validation_and_poll_semantics(pair):
+    """ISSUE 8 satellite: pin the wait-timeout contract. None waits
+    forever, 0 is the documented non-blocking poll (the accumulator and
+    group drain loops rely on it — and wirelint's rpc-result-no-timeout
+    exempts it for exactly that reason); negative and non-finite values
+    are programming errors rejected with a clear ValueError at the call
+    site instead of silently meaning 'no wait'."""
+    from moolib_tpu.rpc import Future
+
+    host, client = pair
+    host.define("vadd", lambda a, b: a + b)
+    fut = client.async_("host", "vadd", 1, 2)
+    assert fut.result(timeout=10) == 3
+    # Done future + timeout=0: immediate result (the poll contract).
+    assert fut.result(timeout=0) == 3
+    assert fut.exception(timeout=0) is None
+    pending = Future()
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        pending.result(timeout=0)  # pending + 0: immediate TimeoutError
+    assert time.monotonic() - t0 < 1.0
+    for bad in (-1, -0.001, float("nan"), float("inf"), float("-inf")):
+        with pytest.raises(ValueError, match="positive finite"):
+            pending.result(timeout=bad)
+        with pytest.raises(ValueError, match="positive finite"):
+            pending.exception(timeout=bad)
+
+
+def test_set_timeout_validation():
+    """Non-positive / non-finite RPC timeouts feed the deadline wheel
+    (0 expires every call pre-send; inf/nan crash the wheel's slot
+    arithmetic) — rejected eagerly with ValueError."""
+    rpc = Rpc("vtimeout")
+    try:
+        for bad in (0, -0.5, float("inf"), float("nan")):
+            with pytest.raises(ValueError, match="positive finite"):
+                rpc.set_timeout(bad)
+        rpc.set_timeout(1.5)
+        assert rpc._timeout == 1.5
+    finally:
+        rpc.close()

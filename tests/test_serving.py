@@ -1,0 +1,662 @@
+"""Serving tier: admission control, deadline propagation, health-gated
+routing, replica failover, graceful drain, hot model swap (ISSUE 8).
+
+Unit layers (AdmissionQueue, CircuitBreaker) are driven directly;
+integration tests stand up real loopback fleets on OS-assigned ports.
+The chaos-scenario acceptance (replica kill mid-load, router partition)
+lives in test_chaos.py via the canonical scenarios, so CI smoke and
+tier-1 pin the same implementation.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu.rpc import Rpc, RpcError
+from moolib_tpu.serving import (
+    AdmissionQueue,
+    CircuitBreaker,
+    DeadlineExceeded,
+    Overloaded,
+    Replica,
+    Router,
+    error_kind,
+    publish_from_accumulator,
+)
+from moolib_tpu.telemetry import Telemetry
+
+
+# ---------------------------------------------------------------------------
+# Admission control (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_overloaded_at_capacity():
+    q = AdmissionQueue(3, service="t_cap", telemetry=Telemetry("t"))
+    for i in range(3):
+        q.admit(i)
+    with pytest.raises(Overloaded, match="capacity"):
+        q.admit(99)
+    serve, shed = q.get_batch(8)
+    assert serve == [0, 1, 2] and shed == []
+    # Capacity freed: admits again.
+    q.admit(3)
+    q.done(3)
+    q.close()
+
+
+def test_admission_shed_order_under_deadline_pressure():
+    """Entries whose remaining budget cannot cover the observed p50
+    service time are shed (explicitly, in queue order); generous-budget
+    entries are served. Shedding needs evidence: before any completion
+    is recorded, nothing is shed."""
+    q = AdmissionQueue(16, service="t_shed", telemetry=Telemetry("t"))
+    now = time.monotonic()
+    # No service-time evidence yet: a tight deadline is still admitted.
+    assert not q.would_shed(now + 0.001)
+    q.admit("early-tight", deadline=now + 0.0005)
+    serve, shed = q.get_batch(8)
+    assert serve == ["early-tight"] and shed == []
+    q.done(1, service_seconds_per_item=0.2)  # p50 is now ~200ms
+
+    # Tight budgets are refused at the door...
+    with pytest.raises(DeadlineExceeded, match="p50"):
+        q.admit("tight", deadline=time.monotonic() + 0.01)
+    # ...and swept at batch-pop in queue order when budget burned away.
+    now = time.monotonic()
+    q.admit("a-tight", deadline=now + 0.25)
+    q.admit("b-ok", deadline=now + 60.0)
+    q.admit("c-tight", deadline=now + 0.26)
+    q.admit("d-no-deadline")
+    time.sleep(0.12)  # burn a-tight/c-tight below the 0.2s estimate
+    serve, shed = q.get_batch(8)
+    assert shed == ["a-tight", "c-tight"], shed
+    assert serve == ["b-ok", "d-no-deadline"], serve
+    q.fail(len(shed), shed=True)
+    q.done(len(serve), service_seconds_per_item=0.2)
+    reg = q._tel.registry
+    assert reg.value("serving_shed_total", service="t_shed") == 3
+    q.close()
+
+
+def test_admission_drain_completes_admitted_work():
+    q = AdmissionQueue(16, service="t_drain", telemetry=Telemetry("t"))
+    for i in range(6):
+        q.admit(i)
+    done = []
+
+    def consumer():
+        while True:
+            serve, _shed = q.get_batch(2, timeout=1.0)
+            if not serve:
+                return
+            time.sleep(0.02)  # admitted work takes real time
+            done.extend(serve)
+            q.done(len(serve))
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    assert q.drain(timeout=10.0), "drain never completed"
+    assert sorted(done) == list(range(6)), "drain dropped admitted work"
+    with pytest.raises(Overloaded, match="draining"):
+        q.admit(99)
+    reg = q._tel.registry
+    assert reg.value("serving_drained_total", service="t_drain") == 1
+    t.join(timeout=5)
+    q.close()
+
+
+def test_error_kind_classification():
+    assert error_kind(Overloaded("x")) == "overloaded"
+    assert error_kind(DeadlineExceeded("x")) == "deadline"
+    assert error_kind(RpcError("Overloaded: queue full")) == "overloaded"
+    assert error_kind(RpcError("DeadlineExceeded: shed")) == "deadline"
+    assert error_kind(RpcError(
+        "request expired in the server queue 'q' before service"
+    )) == "deadline"
+    assert error_kind(RpcError(
+        "connection to rep0 lost before reply to 'serve.infer' "
+        "(reroute disabled)")) == "conn"
+    assert error_kind(RpcError("no route to rep0 for 'serve.infer' "
+                               "(reroute disabled)")) == "conn"
+    assert error_kind(RpcError("call to rep0::serve.infer timed out")) \
+        == "timeout"
+    assert error_kind(RpcError("function 'f' not found on 'rep0'")) \
+        == "not_found"
+    assert error_kind(RpcError("ValueError: boom")) == "other"
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker (unit, driven clock)
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_opens_cools_and_recovers():
+    b = CircuitBreaker(window=8, threshold=0.5, min_samples=4,
+                       cooldown_s=1.0, seed=3)
+    now = 100.0
+    for _ in range(3):
+        b.record(True, now)
+    assert b.state == "closed" and b.allow(now)
+    for _ in range(4):
+        b.record(False, now)
+    assert b.state == "open" and not b.allow(now)
+    assert b.opened_total == 1
+    # allow() is non-mutating: repeated introspection never consumes the
+    # half-open trial.
+    later = now + 2.0
+    assert b.allow(later) and b.allow(later) and b.state == "open"
+    # Dispatch acquires the single trial; concurrent callers are parked.
+    assert b.try_acquire(later)
+    assert b.state == "half_open"
+    assert not b.try_acquire(later) and not b.allow(later)
+    # Trial failure re-opens with a longer (capped-exponential) cooldown.
+    b.record(False, later)
+    assert b.state == "open" and b.opened_total == 2
+    # Next trial succeeds -> closed, ramp reset.
+    later2 = later + 10.0
+    assert b.try_acquire(later2)
+    b.record(True, later2)
+    assert b.state == "closed" and b.allow(later2)
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation (wire level)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def pair():
+    host = Rpc("host")
+    client = Rpc("client")
+    host.listen("127.0.0.1:0")
+    client.connect(host.debug_info()["listen"][0])
+    yield host, client
+    client.close()
+    host.close()
+
+
+def test_call_with_deadline_propagates_budget(pair):
+    host, client = pair
+    seen = {}
+
+    def handler(dr, x):
+        seen["deadline"] = dr.deadline
+        seen["budget"] = dr.budget
+        dr(x * 2)
+
+    host.define_deferred("dl.echo", handler)
+    t0 = time.monotonic()
+    assert client.call_with_deadline(
+        "host", "dl.echo", 3.5, 21).result(timeout=10) == 42
+    assert seen["budget"] == pytest.approx(3.5)
+    # Receiver re-anchored against its own monotonic clock.
+    assert seen["deadline"] == pytest.approx(t0 + 3.5, abs=1.0)
+    # Plain calls carry no deadline.
+    client.async_("host", "dl.echo", 1).result(timeout=10)
+    assert seen["budget"] is None and seen["deadline"] is None
+
+
+def test_call_with_deadline_bounds_queue_entries(pair):
+    """A deadline-stamped queue entry expires at the propagated instant
+    with an EXPLICIT error — never a silent drop that hangs the caller
+    to the RPC deadline."""
+    host, client = pair
+    q = host.define_queue("dl.q")
+    t0 = time.monotonic()
+    fut = client.call_with_deadline("host", "dl.q", 0.3, "x")
+    # Caller side: the budget caps the call's own expiry — an explicit
+    # error at ~0.3s, not the 30s RPC default.
+    with pytest.raises(RpcError, match="timed out"):
+        fut.result(timeout=5)
+    assert time.monotonic() - t0 < 5.0
+    # Server side: the stamped entry is swept (with an explicit error
+    # reply, not a silent drop) the next time the queue pops — the
+    # late reply is dropped client-side; what matters is the server's
+    # bookkeeping never parks the rid as "still executing". The server
+    # re-anchors the budget at RECEIPT, so its expiry lags the client's
+    # by the transport latency — step past it before popping.
+    time.sleep(0.2)
+    with pytest.raises(TimeoutError):
+        q.get(timeout=0.2)
+    with q._cond:
+        assert not q._entries, "expired entry left in the queue"
+
+
+def test_queue_entry_deadline_sweep_is_explicit():
+    """Unit-level pin of the sweep semantics: an expired deadline entry
+    gets cb.error(...) — never a silent drop — and later entries are
+    served normally."""
+    from moolib_tpu.rpc.rpc import Queue
+
+    q = Queue(None, "uq", timeout=lambda: 30.0)
+    got = []
+
+    def mk(tag):
+        def cb(value=None):
+            got.append((tag, "ok", value))
+
+        cb.error = lambda m: got.append((tag, "err", str(m)))
+        return cb
+
+    q._push(mk("tight"), ("a",), {},
+            deadline=time.monotonic() + 0.05)
+    q._push(mk("fine"), ("b",), {})
+    time.sleep(0.1)
+    cb, args, _kwargs = q.get(timeout=1.0)
+    cb(args)
+    assert [(t, k) for t, k, _ in got] == [("tight", "err"), ("fine", "ok")]
+    assert "expired in the server queue" in got[0][2]
+
+
+def test_call_with_deadline_validation(pair):
+    _host, client = pair
+    for bad in (0, -1, float("inf"), float("nan")):
+        with pytest.raises(ValueError, match="positive finite"):
+            client.call_with_deadline("host", "dl.echo", bad, 1)
+
+
+def test_reroute_disabled_fails_fast_on_conn_loss():
+    """The serving-dispatch contract: with reroute=False a dead peer is
+    an explicit error within milliseconds (caller-owned failover), not a
+    silent transport redial until the deadline."""
+    host = Rpc("ffhost")
+    host.listen("127.0.0.1:0")
+    host.define_deferred("ff.slow", lambda dr, x: None)  # never replies
+    client = Rpc("ffclient")
+    client.connect(host.debug_info()["listen"][0])
+    try:
+        fut = client.call_with_deadline("ffhost", "ff.slow", 20.0, 1)
+        time.sleep(0.3)  # let the request land
+        t0 = time.monotonic()
+        host.close()
+        with pytest.raises(RpcError, match="lost before reply"):
+            fut.result(timeout=10)
+        assert time.monotonic() - t0 < 5.0, "conn loss was not fast-failed"
+        # Unroutable peer: explicit error after ~one wheel tick.
+        t0 = time.monotonic()
+        fut2 = client.call_with_deadline("ffhost", "ff.slow", 20.0, 1)
+        with pytest.raises(RpcError, match="no route"):
+            fut2.result(timeout=10)
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        client.close()
+        host.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet integration
+# ---------------------------------------------------------------------------
+
+
+def _mk_replica(i, params, version=1, **kw):
+    import jax
+
+    rpc = Rpc(f"tsrep{i}")
+    rpc.listen("127.0.0.1:0")
+    model = jax.jit(lambda p, x: x * p["scale"])
+    rep = Replica(rpc, model, params, version=version, batch_size=4,
+                  pad=True, **kw)
+    return rpc, rep
+
+
+@pytest.fixture
+def fleet():
+    params = {"scale": np.float32(2.0)}
+    reps = [_mk_replica(i, params) for i in range(2)]
+    router_rpc = Rpc("tsrouter")
+    for rpc, _ in reps:
+        router_rpc.connect(rpc.debug_info()["listen"][0])
+    router = Router(router_rpc, [rpc.get_name() for rpc, _ in reps],
+                    probe_interval_s=0.05, attempt_timeout_s=2.0, seed=5)
+    deadline = time.monotonic() + 20
+    while len(router.routable()) < 2:
+        assert time.monotonic() < deadline, router.stats()
+        time.sleep(0.02)
+    yield router, reps
+    router.close()
+    router.rpc.close()
+    for rpc, rep in reps:
+        rep.close()
+        rpc.close()
+
+
+def test_fleet_serves_batched_jit_inference(fleet):
+    router, reps = fleet
+    futs = [router.infer_async(np.full(3, i, np.float32), budget_s=20.0)
+            for i in range(24)]
+    for i, f in enumerate(futs):
+        np.testing.assert_allclose(f.result(timeout=30), 2.0 * i)
+    # Dynamic batching actually coalesced (pad=True keeps one compile).
+    batched = sum(
+        rpc.telemetry.registry.value("serving_batch_rows_total",
+                                     service="serve") or 0
+        for rpc, _ in reps
+    )
+    batches = sum(
+        rpc.telemetry.registry.value("serving_batches_total",
+                                     service="serve") or 0
+        for rpc, _ in reps
+    )
+    assert batched == 24 and batches <= 24
+
+
+def test_fleet_failover_zero_accepted_dropped(fleet):
+    """Router failover: kill one of two replicas mid-load; every
+    accepted request completes (retry on the survivor) or fails fast
+    with an explicit error — zero accepted-then-dropped."""
+    router, reps = fleet
+    x = np.ones(3, np.float32)
+    router.infer(x, budget_s=20.0)  # warm both pad shapes
+    futs = [router.infer_async(x, budget_s=20.0) for _ in range(40)]
+    time.sleep(0.01)
+    reps[0][0].close()  # hard kill (conns die, listener closes)
+    outcomes = []
+    for f in futs:
+        try:
+            outcomes.append(("ok", f.result(timeout=30)))
+        except RpcError as e:
+            outcomes.append(("err", str(e)))
+    assert len(outcomes) == 40  # every accepted request got an outcome
+    n_ok = sum(1 for k, _ in outcomes if k == "ok")
+    assert n_ok >= 36, outcomes  # failover rescued the fleet
+    # The dead replica leaves rotation (dark probes / breaker).
+    deadline = time.monotonic() + 10
+    while reps[0][0].get_name() in router.routable():
+        assert time.monotonic() < deadline, router.stats()
+        time.sleep(0.05)
+    # And the router's error/retry accounting is on the record.
+    reg = router.rpc.telemetry.registry
+    assert reg.value("serving_router_requests_total",
+                     service="serve") >= 41
+    assert reg.value("serving_router_ok_total", service="serve") \
+        >= n_ok
+
+
+def test_fleet_hot_swap_drops_nothing(fleet):
+    """Hot model-version swap under load: every in-flight request
+    completes, outputs come from exactly the two published versions, and
+    health reports the new version fleet-wide."""
+    router, reps = fleet
+    x = np.ones(3, np.float32)
+    stop = threading.Event()
+    outs, errs = [], []
+    lock = threading.Lock()
+
+    def load():
+        while not stop.is_set():
+            try:
+                out = router.infer(x, budget_s=20.0)
+                with lock:
+                    outs.append(float(out[0]))
+            except RpcError as e:  # pragma: no cover - would fail below
+                with lock:
+                    errs.append(str(e))
+
+    threads = [threading.Thread(target=load, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    acks = router.publish_weights({"scale": np.float32(5.0)}, version=2)
+    assert all(acks.values()), acks
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=20)
+        assert not t.is_alive()
+    assert not errs, errs[:3]
+    # Every output came from exactly one of the two published versions
+    # (a swap mid-batch must never produce a mixed/corrupt reply), and
+    # both versions actually served under the load window.
+    assert set(outs) <= {2.0, 5.0} and {2.0, 5.0} <= set(outs), set(outs)
+    for rpc, rep in reps:
+        assert rep.version == 2
+        info = router.rpc.sync(rpc.get_name(), "serve.health")
+        assert info["model_version"] == 2
+
+
+def test_fleet_graceful_drain(fleet):
+    """drain_replica: the drained replica finishes admitted work, then
+    refuses new work; the router routes around it without breaker
+    penalty; the other replica keeps serving."""
+    router, reps = fleet
+    x = np.ones(3, np.float32)
+    name0 = reps[0][0].get_name()
+    assert router.drain_replica(name0, timeout_s=30.0)
+    deadline = time.monotonic() + 10
+    while name0 in router.routable():
+        assert time.monotonic() < deadline, router.stats()
+        time.sleep(0.05)
+    # Fleet still serves on the survivor; drained peer reports draining.
+    for _ in range(8):
+        np.testing.assert_allclose(router.infer(x, budget_s=20.0), 2.0)
+    st = router.stats()["replicas"][name0]
+    assert st["draining"] and st["breaker"] == "closed", st
+    info = router.rpc.sync(name0, "serve.health")
+    assert info["draining"] is True
+
+
+def test_replica_overload_explicit_and_safe_to_retry():
+    """A saturated replica refuses with Overloaded (bounded queue, no
+    silent growth); the router treats it as a safe retry and lands the
+    request on the sibling."""
+    import jax
+
+    block = threading.Event()
+
+    def slow_model(p, x):
+        block.wait(10.0)
+        return x
+
+    rpc0 = Rpc("ovrep0")
+    rpc0.listen("127.0.0.1:0")
+    rep0 = Replica(rpc0, slow_model, None, batch_size=1, max_queue=2,
+                   service="ov")
+    rpc1 = Rpc("ovrep1")
+    rpc1.listen("127.0.0.1:0")
+    rep1 = Replica(rpc1, jax.jit(lambda p, x: x), None, batch_size=1,
+                   max_queue=64, service="ov")
+    router_rpc = Rpc("ovrouter")
+    router_rpc.connect(rpc0.debug_info()["listen"][0])
+    router_rpc.connect(rpc1.debug_info()["listen"][0])
+    router = Router(router_rpc, ["ovrep0", "ovrep1"], service="ov",
+                    probe_interval_s=0.05, seed=2)
+    try:
+        deadline = time.monotonic() + 20
+        while len(router.routable()) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        x = np.ones(2, np.float32)
+        # Saturate rep0 directly: 1 in service + 2 queued.
+        direct = [router_rpc.call_with_deadline("ovrep0", "ov.infer", 20.0, x)
+                  for _ in range(3)]
+        time.sleep(0.2)
+        with pytest.raises(RpcError, match="Overloaded"):
+            router_rpc.call_with_deadline(
+                "ovrep0", "ov.infer", 5.0, x).result(timeout=10)
+        # The router, meanwhile, retries Overloaded elsewhere: saturate
+        # rep0's slots via the router and keep going — every request
+        # completes because rep1 absorbs the spill.
+        futs = [router.infer_async(x, budget_s=20.0) for _ in range(12)]
+        for f in futs:
+            np.testing.assert_allclose(f.result(timeout=30), 1.0)
+        block.set()
+        for f in direct:
+            f.result(timeout=30)
+    finally:
+        block.set()
+        router.close()
+        router_rpc.close()
+        rep0.close()
+        rep1.close()
+        rpc0.close()
+        rpc1.close()
+
+
+def test_publish_from_accumulator(fleet):
+    """A training cohort's (version, params) publishes into the fleet;
+    the wire contract only needs model_version, so a minimal stand-in
+    accumulator exercises exactly what the helper reads."""
+    router, reps = fleet
+
+    class _Acc:  # duck-typed: .model_version is the published contract
+        model_version = 7
+
+    acks = publish_from_accumulator(router, _Acc(),
+                                    {"scale": np.float32(3.0)})
+    assert all(acks.values())
+    assert all(rep.version == 7 for _rpc, rep in reps)
+    np.testing.assert_allclose(
+        router.infer(np.ones(2, np.float32), budget_s=20.0), 3.0
+    )
+
+
+def test_replica_endpoint_collision_refused():
+    rpc = Rpc("colrep")
+    try:
+        rep = Replica(rpc, lambda p, x: x, None, service="col")
+        with pytest.raises(RpcError, match="already defined"):
+            Replica(rpc, lambda p, x: x, None, service="col")
+        rep.close()
+        # After close the family is undefined: a new replica may claim it.
+        rep2 = Replica(rpc, lambda p, x: x, None, service="col")
+        rep2.close()
+    finally:
+        rpc.close()
+
+
+def test_serving_gauges_unregister_on_close():
+    """The weakref/unregister lifetime contract: a closed replica's and
+    queue's gauge series leave the registry (counters persist as
+    cumulative history)."""
+    rpc = Rpc("gaugerep")
+    rep = Replica(rpc, lambda p, x: x, None, service="gg")
+    reg = rpc.telemetry.registry
+    # Gauges are peer-labelled (the shared-Telemetry rule): two
+    # same-service replicas must never replace or cross-unregister each
+    # other's series.
+    labels = {"service": "gg", "peer": "gaugerep"}
+    assert reg.value("serving_inflight", **labels) == 0
+    assert reg.value("serving_queue_depth", **labels) == 0
+    rep.close()
+    assert reg.value("serving_inflight", **labels) is None
+    assert reg.value("serving_queue_depth", **labels) is None
+    rpc.close()
+
+
+# ---------------------------------------------------------------------------
+# Review-hardening regressions
+# ---------------------------------------------------------------------------
+
+
+def test_capped_attempt_shed_is_retried_not_terminal():
+    """A replica-side DeadlineExceeded against a CAPPED per-attempt
+    budget (the shed was about the slice, not the caller's budget) must
+    be retried on another replica, not surfaced as terminal while most
+    of the budget is unspent."""
+    import jax
+
+    rpcs, reps = [], []
+    for i in range(2):
+        r = Rpc(f"caprep{i}")
+        r.listen("127.0.0.1:0")
+        reps.append(Replica(r, jax.jit(lambda p, x: x), None,
+                            batch_size=2, service="cap"))
+        rpcs.append(r)
+    # Poison rep0's service estimate: its p50 (5s) exceeds any 0.5s
+    # attempt slice, so every dispatch to it sheds at the door.
+    for _ in range(8):
+        reps[0].admission._service_est.observe(5.0)
+    router_rpc = Rpc("caprouter")
+    for r in rpcs:
+        router_rpc.connect(r.debug_info()["listen"][0])
+    router = Router(router_rpc, ["caprep0", "caprep1"], service="cap",
+                    probe_interval_s=0.05, attempt_timeout_s=0.5,
+                    max_retries=2, seed=9)
+    try:
+        deadline = time.monotonic() + 20
+        while len(router.routable()) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        x = np.ones(2, np.float32)
+        for _ in range(20):  # ~half the picks land on the shedding rep0
+            np.testing.assert_allclose(router.infer(x, budget_s=10.0), 1.0)
+        reg = router_rpc.telemetry.registry
+        # An uncapped-attempt deadline stays terminal: drain the budget
+        # below the attempt cap so the slice IS the whole budget.
+        with pytest.raises(DeadlineExceeded):
+            for _ in range(50):
+                router.infer(x, budget_s=0.001)
+        assert reg.value("serving_router_errors_total", service="cap",
+                         kind="deadline") >= 1
+    finally:
+        router.close()
+        router_rpc.close()
+        for rep, r in zip(reps, rpcs):
+            rep.close()
+            r.close()
+
+
+def test_drain_interrupted_by_close_reports_false():
+    """drain() must never report True because close() discarded the
+    admitted work — True means 'admitted work finished', full stop."""
+    q = AdmissionQueue(8, service="t_dc", telemetry=Telemetry("t"))
+    q.admit("a")
+    q.admit("b")
+    got = {}
+
+    def drainer():
+        got["ok"] = q.drain(timeout=10.0)
+
+    t = threading.Thread(target=drainer, daemon=True)
+    t.start()
+    time.sleep(0.1)  # drain is parked on the non-empty queue
+    q.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got["ok"] is False
+    reg = q._tel.registry
+    assert (reg.value("serving_drained_total", service="t_dc") or 0) == 0
+
+
+def test_queue_sweep_expires_non_head_entries():
+    """Deadline stamps make queue expiries non-monotone: an expired
+    short-budget entry BEHIND a long-lived head must still be swept
+    (with its explicit error), not served."""
+    from moolib_tpu.rpc.rpc import Queue
+
+    q = Queue(None, "nm", timeout=lambda: 30.0)
+    got = []
+
+    def mk(tag):
+        def cb(value=None):
+            got.append((tag, "ok"))
+
+        cb.error = lambda m: got.append((tag, "err", str(m)))
+        return cb
+
+    q._push(mk("head-long"), ("a",), {})  # expiry now+30s
+    q._push(mk("tail-tight"), ("b",), {},
+            deadline=time.monotonic() + 0.05)
+    time.sleep(0.1)
+    cb, _args, _kwargs = q.get(timeout=1.0)
+    cb(None)  # serves the live head
+    assert ("head-long", "ok") in got
+    tight = [g for g in got if g[0] == "tail-tight"]
+    assert tight and tight[0][1] == "err", got
+    assert "expired in the server queue" in tight[0][2]
+
+
+def test_replica_not_routable_before_first_probe():
+    """A replica must EARN routability with a successful probe; zero
+    misses at construction is absence of evidence, not health — this is
+    what makes wait-until-routable startup guards real."""
+    from moolib_tpu.serving import ReplicaHealth
+
+    h = ReplicaHealth("ghost")
+    assert not h.routable(time.monotonic())
+    assert h.dark
+    h.probe_ok({"inflight": 0})
+    assert h.routable(time.monotonic()) and not h.dark

@@ -552,3 +552,28 @@ def test_acceptance_chaos_cohort_scrape_and_overhead():
     finally:
         client.close()
         host.close()
+
+
+def test_rolling_quantile_tracks_current_regime():
+    """RollingQuantile (the serving shed estimator): windowed, so a cold
+    outlier ages out instead of poisoning the estimate forever — the
+    property the cumulative Histogram cannot provide."""
+    from moolib_tpu.telemetry import RollingQuantile
+
+    rq = RollingQuantile(window=8)
+    assert rq.quantile(0.5) is None and len(rq) == 0
+    rq.observe(10.0)  # the cold jit compile
+    for _ in range(4):
+        rq.observe(0.01)
+    assert rq.quantile(0.5) == 0.01  # median ignores the single outlier
+    assert rq.quantile(1.0) == 10.0  # max still sees it
+    for _ in range(8):
+        rq.observe(0.02)  # window rolls: the outlier ages out entirely
+    assert rq.quantile(1.0) == 0.02
+    assert len(rq) == 8
+    rq.observe(float("nan"))  # NaN dropped, never poisons the sort
+    assert rq.quantile(0.5) == 0.02
+    with pytest.raises(ValueError):
+        rq.quantile(1.5)
+    with pytest.raises(ValueError):
+        RollingQuantile(window=0)

@@ -1,13 +1,16 @@
 """chaosnet scenario runner: seeded fault-injection soak for the
-RPC/Group/Accumulator stack.
+RPC/Group/Accumulator stack and the serving tier.
 
 Runs the canonical chaos scenarios (``moolib_tpu.testing.scenarios`` —
 the SAME implementations the tier-1 suite pins, so CI smoke and tests
 cannot drift) against a live in-process cluster. Two modes:
 
 - ``--smoke``: one pass over all scenarios (loss storm, partition+heal,
-  leader loss), bounded well under 60s, CPU-only — the CI stage wired
-  into tools/ci_check.sh.
+  leader loss, serving replica-kill mid-load, serving router-partition),
+  bounded well under 60s, CPU-only — the CI stage wired into
+  tools/ci_check.sh. The serving pair is the ROADMAP item-3 acceptance:
+  a router + in-process replicas on OS-assigned ports, one replica
+  killed mid-load, bounded completion and a served-p99 ceiling asserted.
 - ``--seed N --minutes M``: the long-run soak — scenarios loop with
   seeds derived from ``N`` until the time budget is spent, so one
   invocation covers many distinct seeded schedules. Marked slow by

@@ -50,10 +50,14 @@ env JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/perf.py \
   --suite cpu-proxy --smoke --trends bench/trends.jsonl
 
-echo "== chaos smoke =="
-# Bounded seeded fault-injection pass (3 scenarios, well under 60s,
-# CPU-only): loss storm, partition+heal, leader loss. A failure prints
-# the seed + replay command (long-run version: chaos_soak.py --minutes).
+echo "== chaos + serving smoke =="
+# Bounded seeded fault-injection pass (5 scenarios, well under 60s,
+# CPU-only): loss storm, partition+heal, leader loss, plus the serving
+# tier's replica-kill (router + in-process replicas on OS-assigned
+# ports, one killed mid-load: bounded completion, served-p99 ceiling,
+# metric-family consistency) and router-partition (health-gated drain
+# from rotation + return after heal). A failure prints the seed +
+# replay command (long-run version: chaos_soak.py --minutes).
 env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke
 
 echo "== tier-1 tests =="

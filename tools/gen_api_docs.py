@@ -48,6 +48,16 @@ MODULES = [
      "injection (FaultPlan engine + ChaosNet installer)"),
     ("moolib_tpu.testing.scenarios", "canonical chaos scenarios shared by "
      "the tier-1 suite and the CI soak runner"),
+    ("moolib_tpu.serving", "fault-tolerant serving tier: replicated "
+     "inference behind a load-aware router"),
+    ("moolib_tpu.serving.admission", "bounded admission queues, "
+     "deadline-aware shedding, graceful drain"),
+    ("moolib_tpu.serving.health", "probe-miss gating + failure-rate "
+     "circuit breaker for routed replicas"),
+    ("moolib_tpu.serving.replica", "model replica: admission-controlled "
+     "dynamic batching in jit, hot model swap"),
+    ("moolib_tpu.serving.router", "load-aware dispatch, deadline "
+     "propagation, replica failover and retry safety"),
     ("moolib_tpu.parallel.accumulator", "elastic data-parallel gradient "
      "accumulation (ICI psum + DCN tree)"),
     ("moolib_tpu.parallel.mesh", "device mesh construction and batch "
@@ -168,7 +178,9 @@ def _index() -> str:
         "catalogue, span semantics, and the scrape how-to: "
         "[observability.md](observability.md). Benchmark harness "
         "protocol, CPU-proxy suite, perf budgets, and the "
-        "trend/regression gate: [perf.md](perf.md).",
+        "trend/regression gate: [perf.md](perf.md). Serving-tier "
+        "architecture, failure model, deadline/shedding semantics, and "
+        "retry-safety rules: [serving.md](serving.md).",
         "",
         "Other entry points:",
         "",
@@ -184,6 +196,8 @@ def _index() -> str:
         "lint + tier-1 tests, one entrypoint.",
         "- `tools/chaos_soak.py` — chaosnet scenario runner "
         "(`--smoke` CI stage, `--seed N --minutes M` soak).",
+        "- `tools/serving_load.py` — serving-tier load generator "
+        "(throughput/latency report, optional mid-run replica kill).",
         "- `tools/telemetry_dump.py` — scrape a live cohort's "
         "`__telemetry` endpoints into one merged metrics/trace dump.",
         "- `tools/telemetry_smoke.py` — live scrape validation + "
