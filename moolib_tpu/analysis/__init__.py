@@ -23,7 +23,16 @@ families:
   module defines, payload/handler arity skew, duplicate registrations,
   provably unserializable payloads, bare ``.result()`` on RPC-origin
   futures — the bug classes a stringly-typed RPC surface only reveals at
-  runtime on a live cohort).
+  runtime on a live cohort);
+- :mod:`rules_bench` — benchmark timing hygiene (``time.time()``
+  durations in the measurement surface);
+- :mod:`rules_race` — guarded-field & lock-order analysis for the
+  threaded runtime (fields written under ``with self._lock:`` touched
+  bare on thread-entry paths, non-atomic read-modify-writes and
+  check-then-acts, lock released between check and use, cycles in the
+  static acquires-while-holding graph) — the GUARDED_BY/TSan lineage,
+  statically; the dynamic mirror is
+  :mod:`moolib_tpu.testing.locktrace`.
 
 The sharding and protocol families lean on a small interprocedural layer
 in :mod:`engine` (per-module symbol tables + a project index, one import

@@ -34,8 +34,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import fnmatch
 import json
 import re
+import time
 import tokenize
 from collections import Counter
 from pathlib import Path
@@ -460,6 +462,10 @@ class ModuleContext:
             for i, text in enumerate(self.lines, start=1):
                 if "#" in text:
                     comments.append((i, text[text.index("#"):]))
+        #: (lineno, comment text) for every REAL comment — string
+        #: literals containing '#' are not comments. Shared with rule
+        #: modules that define their own marker grammars (rules_race).
+        self.comments: List[Tuple[int, str]] = comments
         for lineno, text in comments:
             m = _SUPPRESS_FILE_RE.search(text)
             if m:
@@ -631,24 +637,35 @@ class ProjectIndex:
 def all_rules() -> List[Rule]:
     """The full registered rule set (async-safety + JAX trace hygiene +
     sharding/collective consistency + RPC round/counter balance + RPC
-    wire-surface consistency + benchmark timing hygiene)."""
+    wire-surface consistency + benchmark timing hygiene + guarded-field
+    / lock-order race analysis)."""
     from . import (rules_async, rules_bench, rules_jax, rules_protocol,
-                   rules_sharding, rules_wire)
+                   rules_race, rules_sharding, rules_wire)
 
     return [
         cls()
         for cls in (rules_async.RULES + rules_jax.RULES
                     + rules_sharding.RULES + rules_protocol.RULES
-                    + rules_wire.RULES + rules_bench.RULES)
+                    + rules_wire.RULES + rules_bench.RULES
+                    + rules_race.RULES)
     ]
 
 
 def _select_rules(rules: Optional[Sequence[Rule]],
                   only: Optional[Sequence[str]]) -> List[Rule]:
+    """``only`` entries are rule names or fnmatch globs (``race-*``
+    selects the whole family); a pattern matching nothing is an error,
+    not a silently-empty run."""
     selected = list(rules) if rules is not None else all_rules()
     if only:
-        wanted = set(only)
-        unknown = wanted - {r.name for r in selected}
+        names = {r.name for r in selected}
+        wanted: set = set()
+        unknown: List[str] = []
+        for pat in only:
+            hits = {n for n in names if fnmatch.fnmatchcase(n, pat)}
+            if not hits:
+                unknown.append(pat)
+            wanted |= hits
         if unknown:
             raise LintError(f"unknown rule(s): {sorted(unknown)}")
         selected = [r for r in selected if r.name in wanted]
@@ -706,10 +723,14 @@ def list_lint_files(paths: Sequence[Path],
 
 def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
                rules: Optional[Sequence[Rule]] = None,
-               only: Optional[Sequence[str]] = None) -> List[Finding]:
+               only: Optional[Sequence[str]] = None,
+               timings: Optional[Dict[str, float]] = None) -> List[Finding]:
     """Lint files/trees. ``root`` anchors the relative paths findings carry
     (default: the current working directory); files outside ``root`` fall
-    back to absolute paths so they can never collide with baselined ones."""
+    back to absolute paths so they can never collide with baselined ones.
+    When ``timings`` is a dict it receives per-rule wall-time (rule name
+    -> cumulative seconds across all files) — the profiling surface
+    behind ``moolint --rule-times``."""
     root = Path(root) if root is not None else Path.cwd()
     selected = _select_rules(rules, only)
     # Phase 1: parse everything, so phase 2 rules can resolve names across
@@ -736,9 +757,13 @@ def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
     for ctx in contexts:
         assert ctx.project is project
         for rule in selected:
+            t0 = time.perf_counter() if timings is not None else 0.0
             for f in rule.check(ctx):
                 if not ctx.suppressed(f.rule, f.line):
                     out.append(f)
+            if timings is not None:
+                timings[rule.name] = timings.get(rule.name, 0.0) \
+                    + (time.perf_counter() - t0)
     return sorted(out)
 
 

@@ -362,15 +362,24 @@ class Accumulator:
             self._result_version = int(v)
 
     def is_leader(self) -> bool:
-        return self._leader == self.rpc.get_name()
+        # Under the (reentrant) lock: election writes _leader on RPC
+        # callback threads, and settle paths read it mid-round — an
+        # unlocked read could see a half-applied election.
+        with self._lock:
+            return self._leader == self.rpc.get_name()
 
     def get_leader(self) -> Optional[str]:
         """Name of the current leader, or None before the first election
         (reference: get_leader, src/moolib.cc)."""
-        return self._leader
+        with self._lock:
+            return self._leader
 
     def connected(self) -> bool:
-        return self.group.active() and self._leader is not None
+        # Same discipline as is_leader(): update() clears _leader under
+        # the lock mid-re-election; an unlocked read here would report
+        # the cohort disconnected for that window.
+        with self._lock:
+            return self.group.active() and self._leader is not None
 
     def set_virtual_batch_size(self, n: int):
         """Change the virtual batch size (reference:

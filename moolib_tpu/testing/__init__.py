@@ -1,13 +1,16 @@
-"""Testing utilities: deterministic fault injection for the RPC stack.
+"""Testing utilities: deterministic fault injection for the RPC stack
+and the dynamic lock-order tracer.
 
 Kept outside the production packages so importing :mod:`moolib_tpu.rpc`
 never pays for (or accidentally enables) chaos machinery; see
-:mod:`moolib_tpu.testing.chaos`.
+:mod:`moolib_tpu.testing.chaos` and :mod:`moolib_tpu.testing.locktrace`.
 """
 
 from .chaos import ChaosNet, Event, FaultPlan
+from .locktrace import LockOrderViolation, LockTrace
 
-__all__ = ["ChaosNet", "Event", "FaultPlan", "SCENARIOS"]
+__all__ = ["ChaosNet", "Event", "FaultPlan", "LockOrderViolation",
+           "LockTrace", "SCENARIOS"]
 
 
 def __getattr__(name):

@@ -562,6 +562,16 @@ def scenario_router_partition(seed: int, *, budget_s: float = 8.0,
         # Served THROUGH the partition: the healthy replicas carry it.
         _await(lambda: _count_ok(outcomes, lock, mark) >= 10, 30,
                "no requests served while partitioned")
+        # The partition must have COST probes. Awaited while still
+        # partitioned (misses keep accruing until heal) rather than
+        # asserted after the fact: the probe loop's cadence is scheduler
+        # timing, and a starved probe thread under host load would
+        # under-count by heal time — the replica can leave rotation via
+        # the dispatch-failure breaker before 3 probes even fire.
+        rreg = fleet.router_rpc.telemetry.registry
+        _await(lambda: (rreg.value("serving_probe_misses_total",
+                                   service=fleet.service) or 0) >= 3,
+               15, "partition never cost a probe")
 
         net.heal("router", target)
         _await(lambda: target in fleet.router.routable(), 30,
@@ -581,7 +591,6 @@ def scenario_router_partition(seed: int, *, budget_s: float = 8.0,
         )
         kinds = {e.kind for e in plan.events}
         assert "partition" in kinds and "partitioned" in kinds, kinds
-        rreg = fleet.router_rpc.telemetry.registry
         assert rreg.value("serving_probe_misses_total",
                           service=fleet.service) >= 3, (
             "partition never cost a probe"

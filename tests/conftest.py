@@ -35,6 +35,51 @@ assert len(jax.devices()) == 8, (
 import pytest  # noqa: E402
 
 
+_faulthandler_fd = None
+
+
+def pytest_configure(config):
+    """Arm a whole-session faulthandler watchdog: if the suite is still
+    running when the timer fires — i.e. something deadlocked and is about
+    to eat the tier-1 870s window silently — every thread's stack is
+    dumped so the hang is diagnosable from the CI log. The default sits
+    just under the outer ``timeout -k 10 870`` so the dump lands BEFORE
+    SIGKILL; ``MOOLIB_FAULTHANDLER_TIMEOUT=0`` disables, any other value
+    re-tunes (tools/ci_check.sh documents the pairing).
+
+    The dump must go to the REAL stderr, not pytest's capture: a
+    SIGKILLed session never flushes capture temp files, so a dump
+    written there would be lost with the hang it describes. Dup the
+    stderr fd at configure time, exactly like pytest's own per-test
+    faulthandler plugin does."""
+    import faulthandler
+
+    timeout = float(os.environ.get("MOOLIB_FAULTHANDLER_TIMEOUT", "840"))
+    if timeout <= 0:
+        return
+    try:
+        fd = sys.stderr.fileno()
+        if fd == -1:
+            raise ValueError
+    except (AttributeError, ValueError):
+        fd = sys.__stderr__.fileno()
+    global _faulthandler_fd
+    _faulthandler_fd = os.dup(fd)  # keep alive for the whole session
+    faulthandler.dump_traceback_later(
+        timeout, exit=False, file=_faulthandler_fd
+    )
+
+
+def pytest_unconfigure(config):
+    import faulthandler
+
+    faulthandler.cancel_dump_traceback_later()
+    global _faulthandler_fd
+    if _faulthandler_fd is not None:
+        os.close(_faulthandler_fd)
+        _faulthandler_fd = None
+
+
 def has_multiprocess_cpu_collectives() -> bool:
     """Capability probe: can THIS jax/jaxlib run multi-process computations
     on the CPU backend?

@@ -1961,6 +1961,400 @@ def test_baseline_identity_survives_line_shifts():
     assert new == [] and fixed == []
 
 
+# -- rules: racelint (guarded fields, atomicity, lock order) ------------------
+
+
+def _lint_race(src):
+    return _lint(src, only=["race-*"])
+
+
+def test_race_unguarded_field_flagged_and_clean():
+    """The canonical shape: a field written under the lock, read bare on
+    a thread-entry path (ISSUE 9's response-cache byte-counter class)."""
+    violation = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pending = {}
+            self._t = threading.Thread(target=self._loop)
+
+        def submit(self, k, v):
+            with self._lock:
+                self._pending[k] = v
+
+        def _loop(self):
+            return len(self._pending)
+    """
+    findings = _lint_race(violation)
+    assert [f.rule for f in findings] == ["race-unguarded-field"]
+    assert "_pending" in findings[0].message
+    assert "Thread target" in findings[0].message
+
+    clean = violation.replace(
+        "        def _loop(self):\n            return len(self._pending)",
+        "        def _loop(self):\n            with self._lock:\n"
+        "                return len(self._pending)",
+    )
+    assert _lint_race(clean) == []
+
+
+def test_race_unguarded_field_executor_and_rpc_handler_entries():
+    """submit(fn) and rpc.define(..., fn) also make fn a thread entry."""
+    src = """
+    import threading
+
+    class Svc:
+        def __init__(self, rpc, pool):
+            self._lock = threading.Lock()
+            self._jobs = []
+            pool.submit(self._work)
+            rpc.define("svc.poke", self._handle)
+
+        def push(self, j):
+            with self._lock:
+                self._jobs.append(j)
+
+        def _work(self):
+            return self._jobs[0]
+
+        def _handle(self):
+            return list(self._jobs)
+    """
+    rules = [f.rule for f in _lint_race(src)]
+    assert rules == ["race-unguarded-field"] * 2
+
+
+def test_race_called_under_lock_inference_silences_private_helper():
+    """A private method whose EVERY internal call site holds the lock is
+    called-with-lock-held by construction (the `_reset_epoch` idiom) —
+    its bare field writes are guarded, not findings."""
+    src = """
+    import threading
+
+    class Round:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._seq = 0
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            with self._lock:
+                self._reset()
+
+        def _reset(self):
+            self._seq = 0
+
+        def bump(self):
+            with self._lock:
+                self._seq += 1
+    """
+    assert _lint_race(src) == []
+    # Same shape but one bare call site: the assumption must not hold.
+    leaky = src.replace(
+        "        def bump(self):",
+        "        def leak(self):\n            self._reset()\n\n"
+        "        def bump(self):",
+    )
+    assert [f.rule for f in _lint_race(leaky)] == ["race-unguarded-field"]
+
+
+def test_race_locked_suffix_convention():
+    """`*_locked` methods are callee-side annotated as lock-held."""
+    src = """
+    import threading
+
+    class Round:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._t = threading.Thread(target=self._settle_locked)
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def _settle_locked(self):
+            self._n -= 1
+    """
+    assert _lint_race(src) == []
+
+
+def test_race_nonatomic_rmw_flagged_and_clean():
+    """`self._n += 1` outside the guarding lock and unlocked
+    check-then-act on a guarded dict — the atomicity lints."""
+    violation = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._cache = {}
+
+        def locked_write(self):
+            with self._lock:
+                self._n = 1
+                self._cache["a"] = 1
+
+        def bump(self):
+            self._n += 1
+
+        def put(self, k, v):
+            if k not in self._cache:
+                with self._lock:
+                    self._cache[k] = v
+    """
+    findings = _lint_race(violation)
+    assert [f.rule for f in findings] == ["race-nonatomic-rmw"] * 2
+    assert "read-modify-write" in findings[0].message
+    assert "check-then-act" in findings[1].message
+
+    clean = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._cache = {}
+
+        def locked_write(self):
+            with self._lock:
+                self._n = 1
+                self._cache["a"] = 1
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def put(self, k, v):
+            with self._lock:
+                if k not in self._cache:
+                    self._cache[k] = v
+    """
+    assert _lint_race(clean) == []
+
+
+def test_race_lock_gap_flagged_and_clean():
+    """Lock released between check and use: a snapshot taken under the
+    lock gates a re-locked write after the gap."""
+    violation = """
+    import threading
+
+    class D:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._jobs = []
+
+        def add(self, j):
+            with self._lock:
+                self._jobs.append(j)
+
+        def drain(self):
+            with self._lock:
+                ready = self._jobs
+            if ready:
+                with self._lock:
+                    self._jobs.pop()
+    """
+    findings = _lint_race(violation)
+    assert [f.rule for f in findings] == ["race-lock-gap"]
+    assert "snapshots self._jobs" in findings[0].message
+
+    clean = """
+    import threading
+
+    class D:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._jobs = []
+
+        def add(self, j):
+            with self._lock:
+                self._jobs.append(j)
+
+        def drain(self):
+            with self._lock:
+                if self._jobs:
+                    self._jobs.pop()
+    """
+    assert _lint_race(clean) == []
+
+
+def test_race_lock_order_cycle_flagged_and_clean():
+    violation = """
+    import threading
+
+    class Twin:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def one(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def two(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+    """
+    findings = _lint_race(violation)
+    assert [f.rule for f in findings] == ["race-lock-order-cycle"]
+    assert "_a_lock" in findings[0].message
+    assert "_b_lock" in findings[0].message
+
+    clean = violation.replace(
+        "            with self._b_lock:\n"
+        "                with self._a_lock:",
+        "            with self._a_lock:\n"
+        "                with self._b_lock:",
+    )
+    assert _lint_race(clean) == []
+
+
+def test_race_relock_nonreentrant_flagged_rlock_clean():
+    """Nested re-acquire of a plain Lock is certain self-deadlock; the
+    same nesting on an RLock is the reentrancy it exists for."""
+    violation = """
+    import threading
+
+    class R:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def oops(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """
+    findings = _lint_race(violation)
+    assert [f.rule for f in findings] == ["race-lock-order-cycle"]
+    assert "self-deadlock" in findings[0].message
+    assert _lint_race(violation.replace("Lock()", "RLock()")) == []
+
+
+def test_race_cross_class_cycle_via_attr_types():
+    """A→B in one class, B→A in the other, linked by a constructor-typed
+    attribute one way and a parameter annotation the other — the
+    cross-class legs of the graph."""
+    src = """
+    import threading
+
+    class Inner:
+        def __init__(self):
+            self._inner_lock = threading.Lock()
+
+        def poke(self, outer: "Outer"):
+            with self._inner_lock:
+                outer.touch()
+
+    class Outer:
+        def __init__(self):
+            self._outer_lock = threading.Lock()
+            self._inner = Inner()
+
+        def drive(self):
+            with self._outer_lock:
+                self._inner.poke(self)
+
+        def touch(self):
+            with self._outer_lock:
+                pass
+    """
+    findings = _lint_race(src)
+    # Two findings, both real: the A→B→A cycle, plus the transitive
+    # re-acquire of the non-reentrant _outer_lock through
+    # drive→poke→touch (self-deadlock on its own).
+    assert [f.rule for f in findings] == ["race-lock-order-cycle"] * 2
+    msgs = " | ".join(f.message for f in findings)
+    assert "lock-order cycle" in msgs and "_inner_lock" in msgs
+    assert "self-deadlock" in msgs
+
+
+def test_race_bare_suppression_flagged_reasoned_suppresses():
+    """The grammar: a bare `# racelint: unguarded` suppresses nothing and
+    is itself a finding; with a reason it silences the race rules."""
+    bare = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._x = 0
+            self._t = threading.Thread(target=self._run)
+
+        def set(self):
+            with self._lock:
+                self._x = 1
+
+        def _run(self):
+            return self._x  # racelint: unguarded
+    """
+    rules = sorted(f.rule for f in _lint_race(bare))
+    assert rules == ["race-bare-suppression", "race-unguarded-field"]
+
+    reasoned = bare.replace(
+        "# racelint: unguarded",
+        "# racelint: unguarded -- monotonic flag; a stale read only "
+        "delays one tick",
+    )
+    assert _lint_race(reasoned) == []
+
+
+def test_race_rules_in_default_suite_and_only_glob():
+    """The family is registered (runs without --only) and `race-*`
+    selects exactly it; a glob matching nothing is an error."""
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def locked_write(self):
+            with self._lock:
+                self._n = 1
+
+        def bump(self):
+            self._n += 1
+    """
+    assert "race-nonatomic-rmw" in {f.rule for f in _lint(src)}
+    assert {f.rule for f in _lint(src, only=["race-*"])} \
+        == {"race-nonatomic-rmw"}
+    with pytest.raises(Exception, match="unknown rule"):
+        _lint(src, only=["race-nope-*"])
+
+
+def test_cli_rule_times(tmp_path):
+    """--rule-times reports per-rule wall-time in check mode and inside
+    --baseline-stats (text and JSON)."""
+    scratch = tmp_path / "scratch.py"
+    scratch.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--rule-times", "--no-baseline",
+         str(scratch)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "per-rule wall-time" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--baseline-stats", "--rule-times",
+         "--json", "--only", "race-*"],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert set(data["rule_seconds"]) == {
+        "race-bare-suppression", "race-unguarded-field",
+        "race-nonatomic-rmw", "race-lock-gap", "race-lock-order-cycle",
+    }
+
+
 # -- recompile guard ----------------------------------------------------------
 
 

@@ -1,0 +1,313 @@
+"""racelint's dynamic side: locktrace unit tests, the tier-1 lock-order
+pass over live scenarios, and stress regressions pinning the races the
+ISSUE 9 baseline burn-down fixed.
+
+The static rules live in tests/test_lint.py; this file covers what only
+execution can show — real acquisition edges, real interleavings.
+"""
+
+import textwrap
+import threading
+import time
+import types
+
+import pytest
+
+from moolib_tpu.testing.locktrace import (
+    LockOrderViolation,
+    LockTrace,
+    static_package_edges,
+)
+
+
+def _load_module(path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(path.stem, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_planted(tmp_path):
+    mod = tmp_path / "planted.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+
+        def ab():
+            with a_lock:
+                with b_lock:
+                    pass
+
+        def ba():
+            with b_lock:
+                with a_lock:
+                    pass
+    """))
+    return mod
+
+
+# -- locktrace unit tests -----------------------------------------------------
+
+
+def test_locktrace_planted_inversion_reported_with_both_stacks(tmp_path):
+    """The acceptance fixture: an A→B/B→A inversion executed for real is
+    reported as a cycle carrying the acquisition stack of BOTH edges."""
+    mod_path = _write_planted(tmp_path)
+    with LockTrace(root=tmp_path) as trace:
+        mod = _load_module(mod_path)
+        mod.ab()
+        mod.ba()
+    assert trace.edges() == {
+        (("planted.py", "a_lock"), ("planted.py", "b_lock")),
+        (("planted.py", "b_lock"), ("planted.py", "a_lock")),
+    }
+    with pytest.raises(LockOrderViolation) as ei:
+        trace.assert_acyclic()
+    msg = str(ei.value)
+    assert "planted.py:a_lock" in msg and "planted.py:b_lock" in msg
+    # Both edges' first-observation stacks are in the report, and they
+    # point at the two distinct call sites that formed the inversion.
+    assert msg.count("first observed at") == 2
+    assert "in ab" in msg and "in ba" in msg
+
+
+def test_locktrace_consistent_order_is_acyclic(tmp_path):
+    mod_path = _write_planted(tmp_path)
+    with LockTrace(root=tmp_path) as trace:
+        mod = _load_module(mod_path)
+        mod.ab()
+        mod.ab()
+    assert trace.edges() == {
+        (("planted.py", "a_lock"), ("planted.py", "b_lock")),
+    }
+    trace.assert_acyclic()  # must not raise
+
+
+def test_locktrace_reentrant_rlock_records_no_edge(tmp_path):
+    mod = tmp_path / "reent.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        r_lock = threading.RLock()
+
+        def twice():
+            with r_lock:
+                with r_lock:
+                    pass
+    """))
+    with LockTrace(root=tmp_path) as trace:
+        _load_module(mod).twice()
+    assert trace.edges(include_same_name=True) == set()
+    trace.assert_acyclic()
+
+
+def test_locktrace_only_factory_bindings_are_named(tmp_path):
+    """Locks born inside stdlib machinery (Thread's ready-Event, a lock
+    built through an aliased factory) have no `Lock()`-shaped binding
+    line in the package and must stay unnamed — invisible to the graph,
+    exactly as they are invisible to the static analysis."""
+    mod = tmp_path / "indirect.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        mk = threading.Lock
+        hidden = mk()                 # no factory call on THIS line
+        named_lock = threading.Lock()
+
+        def nest():
+            with hidden:
+                with named_lock:
+                    pass
+    """))
+    with LockTrace(root=tmp_path) as trace:
+        m = _load_module(mod)
+        m.nest()
+        t = threading.Thread(target=lambda: None)
+        t.start()
+        t.join()
+    # The hidden->named nesting happened, but the hidden lock is
+    # unnamed: no edge may be recorded for it.
+    assert trace.edges(include_same_name=True) == set()
+
+
+def test_locktrace_assert_within_reports_unknown_edge(tmp_path):
+    mod_path = _write_planted(tmp_path)
+    with LockTrace(root=tmp_path) as trace:
+        _load_module(mod_path).ab()
+    known = {(("planted.py", "a_lock"), ("planted.py", "b_lock"))}
+    trace.assert_within(known)  # must not raise
+    with pytest.raises(LockOrderViolation) as ei:
+        trace.assert_within(set())
+    assert "missing from the static" in str(ei.value)
+    assert "planted.py:a_lock -> planted.py:b_lock" in str(ei.value)
+
+
+def test_locktrace_threaded_edges_are_per_thread(tmp_path):
+    """A lock held on thread 1 while thread 2 acquires another lock must
+    NOT fabricate a cross-thread edge: the held-set is per-thread."""
+    mod = tmp_path / "two.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+    """))
+    with LockTrace(root=tmp_path) as trace:
+        m = _load_module(mod)
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with m.a_lock:
+                entered.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert entered.wait(5)
+        with m.b_lock:  # main thread holds nothing else
+            pass
+        release.set()
+        t.join(5)
+    assert trace.edges(include_same_name=True) == set()
+
+
+def test_static_edges_sound_under_mutual_recursion(tmp_path):
+    """A memoized closure computed under a cycle guard would cache a
+    truncated set for mutually recursive helpers and silently drop real
+    edges from the superset; the Kleene fixpoint must not. f<->g where f
+    takes a_lock and g takes b_lock: a caller holding c_lock that calls
+    f reaches BOTH."""
+    from moolib_tpu.analysis.rules_race import static_lock_edges
+
+    mod = tmp_path / "mut.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        a_lock = threading.Lock()
+        b_lock = threading.Lock()
+        c_lock = threading.Lock()
+
+        def f(depth):
+            with a_lock:
+                pass
+            if depth:
+                g(depth - 1)
+
+        def g(depth):
+            with b_lock:
+                pass
+            if depth:
+                f(depth - 1)
+
+        def k():
+            with c_lock:
+                f(2)
+    """))
+    edges = static_lock_edges([mod], root=tmp_path)
+    assert (("mut.py", "c_lock"), ("mut.py", "a_lock")) in edges
+    assert (("mut.py", "c_lock"), ("mut.py", "b_lock")) in edges
+
+    # And the dynamic trace of the same program stays within the set.
+    with LockTrace(root=tmp_path) as trace:
+        _load_module(mod).k()
+    trace.assert_within(edges)
+
+
+def test_static_edges_resolve_function_local_locks(tmp_path):
+    """The tracer names `done_lock = threading.Lock()` locals from their
+    binding line, so the static superset must resolve them too — else
+    the first runtime nesting of a local with a named lock false-fails
+    assert_within on deadlock-free code."""
+    from moolib_tpu.analysis.rules_race import static_lock_edges
+
+    mod = tmp_path / "loc.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+        g_lock = threading.Lock()
+
+        def f():
+            done_lock = threading.Lock()
+            with g_lock:
+                with done_lock:
+                    pass
+    """))
+    edges = static_lock_edges([mod], root=tmp_path)
+    assert (("loc.py", "g_lock"), ("loc.py", "done_lock")) in edges
+
+    with LockTrace(root=tmp_path) as trace:
+        _load_module(mod).f()
+    assert trace.edges() == {
+        (("loc.py", "g_lock"), ("loc.py", "done_lock")),
+    }
+    trace.assert_within(edges)
+
+
+# -- tier-1: the dynamic mirror over live scenarios ---------------------------
+
+
+def test_chaos_and_serving_scenarios_locktrace_clean():
+    """ISSUE 9 acceptance: the dynamic locktrace pass over a chaos smoke
+    scenario AND the ServingFleet scenario (replica-kill) observes zero
+    lock-order inversions, and every observed edge lands inside the
+    static acquires-while-holding over-approximation — so racelint's
+    static 'acyclic' verdict keeps being a proof about the real system."""
+    from moolib_tpu.testing.scenarios import SCENARIOS
+
+    # The ci smoke seeds: deterministic plans with comfortable headroom —
+    # tracing adds per-acquisition overhead, so a near-timeout plan
+    # (drop_storm seed 11 runs ~15s bare against 30s call deadlines)
+    # would test the clock, not the lock graph.
+    with LockTrace() as trace:
+        SCENARIOS["drop_storm"](1)
+        SCENARIOS["replica_kill"](3)
+    # The run must actually have nested locks somewhere (an empty edge
+    # set would make this test vacuous).
+    assert trace.edges(), "no lock nesting observed — tracer broken?"
+    trace.assert_acyclic()
+    trace.assert_within(static_package_edges())
+
+
+# -- stress regressions for the burn-down fixes -------------------------------
+
+
+def test_accumulator_leader_views_are_locked():
+    """Pins the ISSUE 9 fix: is_leader()/get_leader() read _leader under
+    the lock. A writer that only ever mutates _leader INSIDE the lock
+    (clearing it, then restoring it before release — exactly what
+    elections do) must never expose the intermediate None to readers;
+    the pre-fix unlocked read saw it reliably."""
+    from moolib_tpu.parallel.accumulator import Accumulator
+
+    acc = object.__new__(Accumulator)
+    acc._lock = threading.RLock()
+    acc._leader = "me"
+    acc.rpc = types.SimpleNamespace(get_name=lambda: "me")
+
+    stop = threading.Event()
+    torn = []
+
+    def writer():
+        while not stop.is_set():
+            with acc._lock:
+                acc._leader = None  # mid-election: not yet decided
+                time.sleep(0)       # widen the window
+                acc._leader = "me"
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 2.0
+        reads = 0
+        while time.monotonic() < deadline and reads < 20000:
+            if acc.get_leader() is None:
+                torn.append("get_leader saw mid-election None")
+                break
+            if not acc.is_leader():
+                torn.append("is_leader saw mid-election state")
+                break
+            reads += 1
+    finally:
+        stop.set()
+        t.join(5)
+    assert not torn, torn
+    assert reads > 100  # the loop really contended

@@ -57,7 +57,20 @@ def main(argv=None):
                         help="one bounded pass over all scenarios (CI)")
     parser.add_argument("--scenario", choices=sorted(SCENARIOS),
                         help="restrict to one scenario")
+    parser.add_argument("--locktrace", action="store_true",
+                        help="run under instrumented locks "
+                             "(moolib_tpu.testing.locktrace): record the "
+                             "real acquires-while-holding graph, then "
+                             "assert it is acyclic AND inside racelint's "
+                             "static over-approximation")
     args = parser.parse_args(argv)
+
+    trace = None
+    if args.locktrace:
+        from moolib_tpu.testing.locktrace import LockTrace
+
+        trace = LockTrace()
+        trace.activate()
 
     names = [args.scenario] if args.scenario else sorted(SCENARIOS)
     runs = []
@@ -97,11 +110,29 @@ def main(argv=None):
         if args.smoke or (deadline is not None
                           and time.monotonic() > deadline) or not ok:
             break
+    locktrace_report = None
+    if trace is not None:
+        trace.deactivate()
+        from moolib_tpu.testing.locktrace import (LockOrderViolation,
+                                                  static_package_edges)
+
+        locktrace_report = {"edges": len(trace.edges())}
+        try:
+            trace.assert_acyclic()
+            trace.assert_within(static_package_edges())
+        except LockOrderViolation as e:
+            ok = False
+            locktrace_report["violation"] = str(e)
+            print(f"FAIL locktrace: {e}")
+        else:
+            print(f"locktrace: {locktrace_report['edges']} observed "
+                  "lock-order edge(s), acyclic, within the static graph")
     print(json.dumps({
         "ok": ok,
         "runs": len(runs),
         "failed": [r for r in runs if not r["ok"]],
         "total_seconds": round(time.monotonic() - t_start, 1),
+        **({"locktrace": locktrace_report} if locktrace_report else {}),
     }))
     return 0 if ok else 1
 

@@ -12,7 +12,10 @@ fmt=text
 if [ -n "${GITHUB_ACTIONS:-}" ]; then fmt=gha; fi
 
 echo "== moolint: moolib_tpu/ =="
-python tools/moolint.py --check --format="$fmt" moolib_tpu/
+# --rule-times: per-rule wall-time for the 7-family suite rides the run
+# that lints the tree anyway, so a rule that goes quadratic is caught by
+# eye here before it is caught by the test-suite budget.
+python tools/moolint.py --check --format="$fmt" --rule-times moolib_tpu/
 
 echo "== moolint: tools/ tests/ bench*.py =="
 # Separate baseline section for the non-package trees: they are held to
@@ -25,8 +28,9 @@ python tools/moolint.py --check --format="$fmt" \
   bench.py bench_allreduce.py bench_e2e.py
 
 echo "== moolint: baselines must stay empty =="
-# The burn-down hit 0 in PR 3; --fail-nonempty turns any regression (a
-# re-grandfathered finding sneaking back in) into a hard CI failure.
+# The burn-down hit 0 in PR 3 (racelint joined at 0 in PR 9);
+# --fail-nonempty turns any regression (a re-grandfathered finding
+# sneaking back in) into a hard CI failure.
 python tools/moolint.py --baseline-stats --fail-nonempty
 python tools/moolint.py --baseline-stats --fail-nonempty \
   --baseline moolib_tpu/analysis/baseline_tools.json
@@ -58,7 +62,11 @@ echo "== chaos + serving smoke =="
 # metric-family consistency) and router-partition (health-gated drain
 # from rotation + return after heal). A failure prints the seed +
 # replay command (long-run version: chaos_soak.py --minutes).
-env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke
+# --locktrace additionally runs the whole pass under instrumented locks
+# (testing/locktrace.py): the OBSERVED acquires-while-holding graph must
+# stay acyclic (no lock-order inversion ever executed) and inside
+# racelint's static over-approximation (docs/analysis.md).
+env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke --locktrace
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
@@ -66,7 +74,12 @@ rc=0
 # `|| rc=$?` keeps set -e from aborting before the DOTS_PASSED line —
 # which exists precisely for the failing runs (pipefail makes the
 # pipeline status the pytest/timeout status, not tee's).
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+# MOOLIB_FAULTHANDLER_TIMEOUT pairs with the outer `timeout -k 10 870`:
+# conftest.py arms faulthandler.dump_traceback_later at that many
+# seconds, so a real deadlock prints EVERY thread's stack to the log
+# shortly before SIGKILL instead of silently eating the window.
+timeout -k 10 870 env JAX_PLATFORMS=cpu MOOLIB_FAULTHANDLER_TIMEOUT=840 \
+  python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
   -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || rc=$?
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"

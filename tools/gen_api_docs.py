@@ -48,6 +48,8 @@ MODULES = [
      "injection (FaultPlan engine + ChaosNet installer)"),
     ("moolib_tpu.testing.scenarios", "canonical chaos scenarios shared by "
      "the tier-1 suite and the CI soak runner"),
+    ("moolib_tpu.testing.locktrace", "dynamic lock-order tracer: "
+     "instrumented locks, observed acquires-while-holding graph"),
     ("moolib_tpu.serving", "fault-tolerant serving tier: replicated "
      "inference behind a load-aware router"),
     ("moolib_tpu.serving.admission", "bounded admission queues, "

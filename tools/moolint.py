@@ -61,7 +61,14 @@ def main(argv=None) -> int:
     ap.add_argument("--list-rules", action="store_true",
                     help="list registered rules and exit")
     ap.add_argument("--only", action="append", default=None, metavar="RULE",
-                    help="run only these rules (repeatable / comma lists)")
+                    help="run only these rules (repeatable / comma lists; "
+                         "fnmatch globs like 'race-*' select a family)")
+    ap.add_argument("--rule-times", action="store_true",
+                    help="report per-rule wall-time for the lint run; "
+                         "with --baseline-stats, profiles the suite over "
+                         "the default package tree (honors --only) so "
+                         "the now-7-family suite can be profiled "
+                         "selectively in CI and locally")
     ap.add_argument("--format", choices=("text", "json", "gha"),
                     default=None, dest="fmt",
                     help="output format: text (default), json "
@@ -99,15 +106,20 @@ def main(argv=None) -> int:
             print("moolint: error: --baseline-stats takes no paths; pick "
                   "the ledger with --baseline", file=sys.stderr)
             return 2
-        return baseline_stats(args)
+        only = None
+        if args.only:
+            only = [r for chunk in args.only for r in chunk.split(",") if r]
+        return baseline_stats(args, only)
 
     paths = [Path(p) for p in (args.paths or [REPO_ROOT / "moolib_tpu"])]
     only = None
     if args.only:
         only = [r for chunk in args.only for r in chunk.split(",") if r]
 
+    timings = {} if args.rule_times else None
     try:
-        findings = lint_paths(paths, root=REPO_ROOT, only=only)
+        findings = lint_paths(paths, root=REPO_ROOT, only=only,
+                              timings=timings)
     except LintError as e:
         print(f"moolint: error: {e}", file=sys.stderr)
         return 2
@@ -143,11 +155,16 @@ def main(argv=None) -> int:
     new, fixed = diff_against_baseline(findings, baseline)
 
     if args.as_json:
-        print(json.dumps({
+        out = {
             "findings": [f.to_dict() for f in findings],
             "new": [f.to_dict() for f in new],
             "fixed_baseline_entries": fixed,
-        }, indent=1))
+        }
+        if timings is not None:
+            out["rule_seconds"] = {
+                k: round(v, 4) for k, v in timings.items()
+            }
+        print(json.dumps(out, indent=1))
     else:
         for f in new:
             if args.fmt == "gha":
@@ -167,11 +184,22 @@ def main(argv=None) -> int:
             + (f", {sum(e['count'] for e in fixed)} baseline entr(ies) "
                "fixed — shrink with --baseline-update" if fixed else "")
         )
+        if timings is not None:
+            _print_rule_times(timings)
     return 1 if new else 0
 
 
-def baseline_stats(args) -> int:
-    """Burn-down visibility: how much grandfathered debt remains."""
+def _print_rule_times(timings: dict):
+    total = sum(timings.values())
+    print(f"moolint: per-rule wall-time ({total:.2f}s total):")
+    for rule, secs in sorted(timings.items(), key=lambda kv: -kv[1]):
+        print(f"  {secs * 1000:8.1f}ms  {rule}")
+
+
+def baseline_stats(args, only=None) -> int:
+    """Burn-down visibility: how much grandfathered debt remains; with
+    --rule-times, also profiles the suite over the package tree so the
+    burn-down line and the per-rule cost land in one CI block."""
     if not args.baseline.exists():
         print(f"moolint: baseline {args.baseline}: absent (0 grandfathered "
               "findings)")
@@ -190,13 +218,27 @@ def baseline_stats(args) -> int:
         n = int(e.get("count", 1))
         per_rule[e["rule"]] = per_rule.get(e["rule"], 0) + n
         per_file[e["path"]] = per_file.get(e["path"], 0) + n
+    timings = None
+    if args.rule_times:
+        timings = {}
+        try:
+            lint_paths([REPO_ROOT / "moolib_tpu"], root=REPO_ROOT,
+                       only=only, timings=timings)
+        except LintError as e:
+            print(f"moolint: error: {e}", file=sys.stderr)
+            return 2
     if args.as_json:
-        print(json.dumps({
+        out = {
             "baseline": str(args.baseline),
             "total": total,
             "per_rule": per_rule,
             "per_file": per_file,
-        }, indent=1))
+        }
+        if timings is not None:
+            out["rule_seconds"] = {
+                k: round(v, 4) for k, v in timings.items()
+            }
+        print(json.dumps(out, indent=1))
     else:
         print(f"moolint: baseline {args.baseline.name}: {total} "
               f"grandfathered finding(s) across {len(per_file)} file(s)")
@@ -204,6 +246,8 @@ def baseline_stats(args) -> int:
             print(f"  {n:4d}  {rule}")
         for path, n in sorted(per_file.items(), key=lambda kv: -kv[1]):
             print(f"  {n:4d}  {path}")
+        if timings is not None:
+            _print_rule_times(timings)
     if rc:
         print(f"moolint: error: {args.baseline} grandfathers {total} "
               "finding(s); the burn-down reached 0 in PR 3 and the "
