@@ -62,7 +62,14 @@ class A2CConfig:
     baseline_cost: float = 0.005
     grad_clip: float = 40.0
     virtual_batch_size: Optional[int] = None  # default: one peer's batch
+    # Survivable-training knobs (ISSUE 11): commit gradient rounds with
+    # K-of-N contributions after the straggler deadline (None = all);
+    # a standby broker address+name enables member-driven failover.
+    min_quorum: Optional[int] = None
+    straggler_timeout: Optional[float] = None
     broker: Optional[str] = None  # None -> start an in-process broker
+    broker_standby: Optional[str] = None  # standby broker address
+    broker_standby_name: str = "broker2"
     group: str = "a2c"
     log_interval_steps: int = 4_000
     seed: int = 0
@@ -211,7 +218,17 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
         virtual_batch_size=cfg.virtual_batch_size or cfg.batch_size,
         get_state=get_state,
         set_state=set_state,
+        min_quorum=cfg.min_quorum,
+        straggler_timeout=cfg.straggler_timeout,
     )
+    if cfg.broker_standby:
+        # Member-driven broker failover: a dark primary is written off
+        # after a few ping intervals and the standby adopts the epoch
+        # from cohort gossip (docs/reliability.md).
+        rpc.connect(cfg.broker_standby)
+        accumulator.group.set_broker_candidates(
+            ["broker", cfg.broker_standby_name]
+        )
 
     from moolib_tpu.examples.envs import make_env_fn
 

@@ -80,6 +80,14 @@ class VtraceConfig:
     max_seconds: Optional[float] = None  # wall-clock stop (benchmarks)
     # infra
     broker: Optional[str] = None  # None -> in-process broker
+    # Survivable training (ISSUE 11): a standby broker (address + peer
+    # name) enables member-driven failover with gossip epoch adoption;
+    # min_quorum commits gradient rounds with K-of-N contributions after
+    # the straggler deadline instead of failing on one stalled peer.
+    broker_standby: Optional[str] = None
+    broker_standby_name: str = "broker2"
+    min_quorum: Optional[int] = None
+    straggler_timeout: Optional[float] = None
     group: str = "vtrace"
     savedir: Optional[str] = None
     # Capture an XLA trace of updates [10, 13) — 3 steady-state updates,
@@ -263,7 +271,17 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
         set_state=set_state,
         parallel_gradients=cfg.parallel_gradients,
         state_broadcast_interval=cfg.state_broadcast_interval,
+        min_quorum=cfg.min_quorum,
+        straggler_timeout=cfg.straggler_timeout,
     )
+    if cfg.broker_standby:
+        # Member-driven broker failover: a dark primary is written off
+        # after a few ping intervals and the standby adopts the epoch
+        # from cohort gossip (docs/reliability.md).
+        rpc.connect(cfg.broker_standby)
+        accumulator.group.set_broker_candidates(
+            ["broker", cfg.broker_standby_name]
+        )
 
     ckpt = None
     if cfg.savedir:

@@ -26,6 +26,18 @@ accumulated local gradient sum (or None). The reduced sum is divided by the
 total sample count and surfaced via ``has_gradients()``/
 ``result_gradients()``.
 
+Quorum rounds (``min_quorum``): by default every member must contribute
+to every round (a stalled member fails the round at the collective
+timeout). With ``min_quorum=K`` configured, the group layer writes
+stragglers off at a (height-staged) per-round deadline and the round
+commits with K-of-N contributions: the result carries the participating
+member set, the gradient mean divides by the *participating* sample
+count, members the commit provably excluded re-contribute their bundles
+into the next round (never double-applied), and a result below quorum is
+rejected identically on every member and retried. The requested quorum
+is negotiated through the count allreduce (strictest wins) so all
+members always apply the same commit rule.
+
 Pipelining (``parallel_gradients`` > 1, reference:
 set_parallel_gradients / the in-flight reduction ring,
 src/accumulator.cc:251-256): count rounds keep running while gradient
@@ -159,9 +171,27 @@ def _grad_merge(a, b):
     return (_tree_add(ba, bb), na + nb)
 
 
+def _qgrad_merge(a, b):
+    """Merge quorum-round (bundle_or_none, n_grads, batch_sum, names)
+    tuples. ``names`` unions the participating members, so the committed
+    result is self-describing: every member — straggler included — can
+    tell from the share alone whether its own contribution made the sum
+    (and must therefore re-contribute it next round)."""
+    (ba, na, sa, ma), (bb, nb, sb, mb) = a, b
+    return (_tree_add(ba, bb), na + nb, sa + sb, ma + mb)
+
+
+def _q_strictest(qa: int, qb: int) -> int:
+    """Merge two requested quorums; 0 encodes require-all (the strictest
+    possible request, so it dominates)."""
+    if qa == 0 or qb == 0:
+        return 0
+    return max(qa, qb)
+
+
 def _count_merge(a, b):
     """Merge (batch_size, n_grads, has_template, requested_vbs,
-    chunk_bytes) tuples.
+    chunk_bytes, requested_quorum, names) tuples.
 
     The count result is identical on every peer (it is an allreduce), so
     it doubles as the NEGOTIATION channel for everything the following
@@ -187,9 +217,18 @@ def _count_merge(a, b):
       chunking-disabled anywhere, disables it everywhere) instead of
       livelocking. NOTE the count tuple itself is a protocol surface:
       peers must run the same framework version (tuple arity is not
-      negotiated)."""
-    (bsa, nga, ta, va, ca), (bsb, ngb, tb, vb, cb) = a, b
-    return (bsa + bsb, nga + ngb, ta and tb, max(va, vb), min(ca, cb))
+      negotiated).
+    - ``requested_quorum`` merges STRICTEST across members (0 = require
+      all, which dominates; else max): every completion then applies the
+      same K-of-N commit rule to the same round, so a partially-forwarded
+      result is accepted or rejected identically cluster-wide.
+    - ``names`` unions the members whose contribution actually reached
+      the committed sum — under straggler write-offs that may be a
+      strict subset of the membership, and a member missing from it
+      knows to re-contribute its snapshot next round."""
+    (bsa, nga, ta, va, ca, qa, ma), (bsb, ngb, tb, vb, cb, qb, mb) = a, b
+    return (bsa + bsb, nga + ngb, ta and tb, max(va, vb), min(ca, cb),
+            _q_strictest(qa, qb), ma + mb)
 
 
 class Accumulator:
@@ -215,12 +254,18 @@ class Accumulator:
         parallel_gradients: int = 1,
         state_broadcast_interval: Optional[float] = 600.0,
         chunk_bytes: Optional[int] = None,
+        min_quorum: Optional[int] = None,
+        straggler_timeout: Optional[float] = None,
     ):
         # Validate BEFORE any side effect: creating the Group registers
         # service handlers on the rpc, which must not happen for a
         # constructor call that raises.
         if virtual_batch_size < 1:
             raise ValueError("virtual_batch_size must be >= 1")
+        if min_quorum is not None and min_quorum < 1:
+            raise ValueError("min_quorum must be >= 1 (or None for all)")
+        if straggler_timeout is not None and not straggler_timeout > 0:
+            raise ValueError("straggler_timeout must be positive")
         if rpc.defined("AccumulatorService::requestState"):
             # Same-fid clobbering: a second Accumulator on one Rpc would
             # silently replace the first one's state handlers.
@@ -293,6 +338,28 @@ class Accumulator:
             CHUNK_BYTES_DEFAULT if chunk_bytes is None else int(chunk_bytes)
         )
         self._neg_chunk: Optional[int] = None    # last negotiated value
+        # Quorum rounds: commit with K-of-N contributions once the
+        # straggler deadline passes instead of failing the whole round on
+        # one stalled member. None = require every member (the default,
+        # and the pre-quorum behavior). The requested value rides the
+        # count allreduce (strictest-merge, see _count_merge) so every
+        # member applies the same commit rule; the straggler deadline is
+        # a local write-off knob and needs only rough agreement.
+        self._min_quorum = None if min_quorum is None else int(min_quorum)
+        self._straggler_timeout = (
+            max(0.5, min(2.0, self.group.timeout / 4.0))
+            if straggler_timeout is None else float(straggler_timeout)
+        )
+        # Last NEGOTIATED quorum (out of the count allreduce). Straggler
+        # write-offs key off THIS, not the local config: under mixed
+        # config the strictest-merge yields require-all, and writing
+        # stragglers off against a require-all commit rule would reject
+        # every partial round forever (livelock) where plain waiting
+        # would have succeeded within the timeout. Until the first
+        # negotiation lands (None), rounds run require-all with no
+        # write-offs — strictly safe.
+        self._neg_quorum: Optional[int] = None
+        self._last_participation: Optional[Tuple[int, int]] = None
         self._committed_bundle = None            # counted, awaiting grad round
         self._committed_bs = 0
         self._committed_ngrads = 0
@@ -321,6 +388,21 @@ class Accumulator:
         )
         self._m_elections = reg.counter("acc_elections_total")
         self._m_user_skips = reg.counter("acc_skip_gradients_total")
+        # Quorum-round telemetry: rounds committed below full
+        # participation (count vs gradient), member-contributions written
+        # off across those commits, rounds rejected for missing quorum,
+        # this peer's own late re-contributions, and the per-round
+        # participation fraction.
+        self._m_partial_count_rounds = reg.counter(
+            "acc_partial_count_rounds_total"
+        )
+        self._m_partial_grad_rounds = reg.counter(
+            "acc_partial_gradient_rounds_total"
+        )
+        self._m_quorum_rejected = reg.counter("acc_quorum_rejected_total")
+        self._m_writeoffs = reg.counter("acc_straggler_writeoffs_total")
+        self._m_recontributed = reg.counter("acc_recontributed_total")
+        self._m_participation = reg.histogram("acc_round_participation")
         # The registry outlives this Accumulator; a strong `self` in the
         # gauge closures would pin model-sized buffers (_zeros_bundle,
         # _committed_bundle, _results) after close(). A dead ref scrapes
@@ -557,6 +639,7 @@ class Accumulator:
         self._round_inflight = False
         self._grads_inflight = 0
         self._dark_failures = 0
+        self._neg_quorum = None  # renegotiated with the new membership
         self._grad_outcomes.clear()
         self._release_gseq = 0
         self._cumulative_bs = 0
@@ -802,7 +885,7 @@ class Accumulator:
             nonlocal snap_parts, snap_bs, snap_ng
             try:
                 (total_bs, total_ng, all_templ, eff_vbs,
-                 neg_chunk) = fut.result(timeout=0)
+                 neg_chunk, eff_q, names) = fut.result(timeout=0)
             except (asyncio.CancelledError,
                     concurrent.futures.CancelledError):
                 # The in-flight reduction was CANCELLED (elastic membership
@@ -904,7 +987,7 @@ class Accumulator:
                 self._commit_count_round_locked(
                     epoch, seq, snap_bundle, snap_bs, snap_ng,
                     restore_snapshot_locked,
-                    total_bs, all_templ, eff_vbs, neg_chunk,
+                    total_bs, all_templ, eff_vbs, neg_chunk, eff_q, names,
                 )
             finally:
                 if cancelled is not None:
@@ -914,8 +997,22 @@ class Accumulator:
             fut = self.group.all_reduce(
                 f"acc.count.{seq}.{self._attempt}",
                 (snap_bs, snap_ng, self._bundle_template is not None,
-                 self.virtual_batch_size, self._chunk_bytes),
+                 self.virtual_batch_size, self._chunk_bytes,
+                 0 if self._min_quorum is None else self._min_quorum,
+                 (self.rpc.get_name(),)),
                 op=_count_merge,
+                # Straggler write-offs only when the NEGOTIATED quorum
+                # (strictest across members, from the previous count
+                # round) names fewer members than the roster: a partial
+                # result against a require-all commit rule could only
+                # ever be rejected, so writing stragglers off would
+                # livelock rounds that plain waiting wins.
+                straggler_timeout=(
+                    self._straggler_timeout
+                    if (self._neg_quorum is not None
+                        and 0 < self._neg_quorum < len(self.group.members))
+                    else None
+                ),
             )
         except RpcError:
             with self._lock:
@@ -924,12 +1021,24 @@ class Accumulator:
             return
         fut.add_done_callback(done)
 
+    def _repend_locked(self, bundle, bs, ngrads):
+        """Return an already-committed contribution to the pending list so
+        it re-enters a later count round — the path for contributions a
+        quorum commit provably excluded (never double-applied: the
+        committed sum demonstrably lacks them)."""
+        if bundle is not None:
+            self._pending_parts.insert(0, bundle)
+        self._pending_bs += bs
+        self._pending_ngrads += ngrads
+
     def _commit_count_round_locked(self, epoch, seq, snap_bundle, snap_bs,
                                    snap_ng, restore_snapshot_locked,
-                                   total_bs, all_templ, eff_vbs, neg_chunk):
-        """Locked tail of a successful count round: commit the snapshot,
-        advance the sequence, and trigger the gradient round when the
-        allreduced cumulative count crosses the virtual batch size."""
+                                   total_bs, all_templ, eff_vbs, neg_chunk,
+                                   eff_q, names):
+        """Locked tail of a successful count round: apply the quorum
+        commit rule, commit the snapshot, advance the sequence, and
+        trigger the gradient round when the allreduced cumulative count
+        crosses the virtual batch size."""
         with self._lock:
             if self._epoch != epoch:
                 # Success for a dead epoch: counts were discarded by the
@@ -937,6 +1046,24 @@ class Accumulator:
                 restore_snapshot_locked()
                 return
             self._round_inflight = False
+            # The negotiated quorum gates the NEXT round's straggler
+            # write-offs (recorded from rejected rounds too — the
+            # negotiation itself succeeded either way).
+            self._neg_quorum = int(eff_q)
+            # Membership is epoch-stable (a change mints a new sync id,
+            # which cancels the round), so this is the round's roster.
+            n = len(self.group.members) or 1
+            required = n if eff_q <= 0 else min(int(eff_q), n)
+            if len(names) < required:
+                # Below quorum: every member sees the same result and
+                # rejects identically — the partial totals are discarded,
+                # the snapshot re-enters pending, and the round retries
+                # under a fresh attempt key.
+                self._m_quorum_rejected.inc()
+                restore_snapshot_locked()
+                self._attempt += 1
+                self._user_has_contributed = False
+                return
             self._dark_failures = 0
             self._seq = seq + 1
             self._m_count_rounds.inc()
@@ -946,11 +1073,23 @@ class Accumulator:
             # (reference: wantsGradients re-arms each cycle,
             # src/moolib.cc:1645-1862).
             self._user_has_contributed = False
-            self._committed_bundle = _tree_add(
-                self._committed_bundle, snap_bundle
-            )
-            self._committed_bs += snap_bs
-            self._committed_ngrads += snap_ng
+            if self.rpc.get_name() in names:
+                self._committed_bundle = _tree_add(
+                    self._committed_bundle, snap_bundle
+                )
+                self._committed_bs += snap_bs
+                self._committed_ngrads += snap_ng
+            else:
+                # Written off this round: total_bs provably excludes this
+                # snapshot, so it re-enters pending and is re-counted by
+                # the next round (late contribution, never lost and never
+                # double-counted).
+                if snap_bs or snap_ng or snap_bundle is not None:
+                    self._m_recontributed.inc()
+                restore_snapshot_locked()
+            if len(names) < n:
+                self._m_partial_count_rounds.inc()
+                self._m_writeoffs.inc(n - len(names))
             self._cumulative_bs += total_bs
             # eff_vbs and all_templ are identical on every member
             # (they came out of the allreduce), so every member makes
@@ -961,7 +1100,7 @@ class Accumulator:
             if eff_vbs <= self._cumulative_bs:
                 self._start_grad_round(
                     self._cumulative_bs, chunked=bool(all_templ),
-                    chunk_bytes=neg_chunk,
+                    chunk_bytes=neg_chunk, quorum=int(eff_q),
                 )
 
     def _release_ready_locked(self):
@@ -983,7 +1122,8 @@ class Accumulator:
             self._results.append((out[0], out[1], self._model_version))
 
     def _start_grad_round(self, count: int, chunked: bool = False,
-                          chunk_bytes: Optional[int] = None):
+                          chunk_bytes: Optional[int] = None,
+                          quorum: int = 0):
         """All peers enter deterministically once counts cross the virtual
         batch size (reference: startReduce, src/accumulator.cc:1005-1033).
 
@@ -1000,15 +1140,33 @@ class Accumulator:
         custom merge ships one monolithic message per hop. Non-contributors
         pay a zeros bundle; contributors (the common steady-state case) pay
         nothing extra.
+
+        ``quorum`` (negotiated through the count round that triggered this
+        round, identical on every member; 0 = require all): when it names
+        fewer members than the roster, the round runs in quorum mode — a
+        monolithic custom merge that carries (bundle, n_grads, batch_sum,
+        names) so the straggler write-offs the group layer performs at
+        the straggler deadline stay visible in the result. A committed
+        quorum round divides by the PARTICIPATING batch sum, members
+        missing from ``names`` re-contribute their bundle next round, and
+        a result below quorum is rejected identically everywhere. Quorum
+        rounds are never chunked (a partial cut of independent sub-ops
+        could commit different participant sets per chunk).
         """
         epoch = self._epoch
         gseq = self._gseq
         self._gseq = gseq + 1
         bundle = self._committed_bundle
         ngrads = self._committed_ngrads
+        bs_stake = self._committed_bs
         self._committed_bundle = None
         self._committed_bs = 0
         self._committed_ngrads = 0
+        n_start = len(self.group.members) or 1
+        quorum_mode = 0 < quorum < n_start
+        required = n_start if quorum <= 0 else min(int(quorum), n_start)
+        if quorum_mode:
+            chunked = False
         # Telemetry before the gate raise: nothing between raising
         # _grads_inflight and handing off to the collective may throw.
         round_t0 = time.monotonic()
@@ -1030,8 +1188,13 @@ class Accumulator:
                     res = fut.result(timeout=0)
                     total_ng = int(res["n"][0])
                     total_bundle = res["b"] if total_ng > 0 else None
+                    q_names = q_bs = None
+                elif quorum_mode:
+                    (total_bundle, total_ng, q_bs,
+                     q_names) = fut.result(timeout=0)
                 else:
                     total_bundle, total_ng = fut.result(timeout=0)
+                    q_names = q_bs = None
             except (asyncio.CancelledError,
                     concurrent.futures.CancelledError):
                 # Cancelled mid-reduction (membership change): settle this
@@ -1063,7 +1226,33 @@ class Accumulator:
                 if self._epoch != epoch:
                     return
                 self._dark_failures = 0
-                if total_bundle is None:
+                divisor = count
+                if quorum_mode:
+                    if len(q_names) < required:
+                        # Below quorum: identical result on every member,
+                        # so everyone rejects, discards the partial sum,
+                        # and re-pends its own stake for the next round.
+                        self._m_quorum_rejected.inc()
+                        self._repend_locked(bundle, bs_stake, ngrads)
+                        settle_locked(None)
+                        return
+                    self._m_participation.observe(len(q_names) / n_start)
+                    self._last_participation = (len(q_names), n_start)
+                    if len(q_names) < n_start:
+                        self._m_partial_grad_rounds.inc()
+                        self._m_writeoffs.inc(n_start - len(q_names))
+                    if self.rpc.get_name() not in q_names:
+                        # My bundle provably missed the committed sum:
+                        # late contribution — it re-enters pending and
+                        # lands in a later round, never double-applied.
+                        if bundle is not None:
+                            self._m_recontributed.inc()
+                        self._repend_locked(bundle, bs_stake, ngrads)
+                    # The mean divides by the PARTICIPATING batch sum:
+                    # written-off samples are not in the numerator, so
+                    # they must not be in the denominator either.
+                    divisor = q_bs
+                if total_bundle is None or (quorum_mode and q_bs <= 0):
                     self._m_rounds_empty.inc()
                     settle_locked(None)  # nobody contributed
                     return
@@ -1072,9 +1261,9 @@ class Accumulator:
                     # shape, flipping future rounds to the chunked format.
                     self._bundle_template = _bundle_spec(total_bundle)
                 mean = nest.map_structure(
-                    lambda x: x / count, total_bundle
+                    lambda x: x / divisor, total_bundle
                 )
-                settle_locked((mean, count))
+                settle_locked((mean, divisor))
 
         try:
             if chunked:
@@ -1093,6 +1282,13 @@ class Accumulator:
                      "n": np.array([ngrads], np.int64)},
                     op="sum",
                     chunk_bytes=chunk_bytes,
+                )
+            elif quorum_mode:
+                fut = self.group.all_reduce(
+                    f"acc.grads.{gseq}",
+                    (bundle, ngrads, bs_stake, (self.rpc.get_name(),)),
+                    op=_qgrad_merge,
+                    straggler_timeout=self._straggler_timeout,
                 )
             else:
                 fut = self.group.all_reduce(
@@ -1137,6 +1333,12 @@ class Accumulator:
                 "dark_failures": self._dark_failures,
                 "elections": int(self._m_elections.value),
                 "skipped_rounds": int(self._m_rounds_empty.value),
+                "min_quorum": self._min_quorum,
+                "negotiated_quorum": self._neg_quorum,
+                "last_participation": self._last_participation,
+                "quorum_rejected": int(self._m_quorum_rejected.value),
+                "straggler_writeoffs": int(self._m_writeoffs.value),
+                "recontributed": int(self._m_recontributed.value),
             }
 
     def close(self):

@@ -21,7 +21,7 @@ import secrets
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Optional, Set
 
 from ..utils import get_logger
 from .rpc import Rpc
@@ -42,6 +42,10 @@ class _PeerEntry:
     synced_id: Optional[str] = None
     push_inflight: bool = False
     last_push: float = 0.0
+    # Per-process nonce from the peer's Group: a restarted process that
+    # reuses its old name pings with a NEW incarnation, which must never
+    # be mistaken for the dead one (its sequence/epoch state is gone).
+    incarnation: Optional[str] = None
 
 
 @dataclass
@@ -50,6 +54,16 @@ class _GroupEntry:
     peers: Dict[str, _PeerEntry] = field(default_factory=dict)
     needs_update: bool = False
     creation_counter: int = 0
+    # Epoch adoption (standby promotion): a broker that learns of a group
+    # from a ping that already CARRIES a sync id re-materializes the
+    # epoch from cohort gossip instead of minting a fresh one. While
+    # ``settling_until`` is in the future the roster is still forming:
+    # no expiry, no minting, no pushes. At settle end, an intact roster
+    # (every expected member pinged in with the adopted id) continues the
+    # epoch untouched — in-flight collective ops survive the promotion.
+    settling_until: Optional[float] = None
+    expected_members: Optional[Set[str]] = None
+    adopt_mismatch: bool = False
 
     def sorted_members(self):
         # Sort by (sort_order, creation_order) like the reference
@@ -74,10 +88,15 @@ class Broker:
             broker.update(); time.sleep(0.25)
     """
 
-    def __init__(self, rpc: Optional[Rpc] = None, name: str = "broker"):
+    def __init__(self, rpc: Optional[Rpc] = None, name: str = "broker",
+                 settle_s: float = 2.5):
         self._owns_rpc = rpc is None
         self.rpc = rpc or Rpc(name)
         self._groups: Dict[str, _GroupEntry] = {}
+        # How long an adopted epoch's roster is given to re-materialize
+        # from pings before this broker starts arbitrating (should cover
+        # a couple of the cohort's ping intervals).
+        self.settle_s = float(settle_s)
         # _ping runs on RPC executor threads while update() runs on the CLI
         # thread; one lock covers all membership state.
         self._lock = threading.Lock()
@@ -86,24 +105,68 @@ class Broker:
     # -- service -------------------------------------------------------------
 
     def _ping(self, group: str, peer_name: str, timeout: float,
-              sync_id: Optional[str], sort_order: int = 0) -> dict:
+              sync_id: Optional[str], sort_order: int = 0,
+              incarnation: Optional[str] = None,
+              members: Optional[list] = None) -> dict:
+        now = time.monotonic()
         with self._lock:
             g = self._groups.get(group)
             if g is None:
-                g = self._groups[group] = _GroupEntry(sync_id=_new_sync_id())
+                if sync_id is not None:
+                    # Standby promotion: the cohort already HAS an epoch —
+                    # adopt it from gossip instead of minting, and give
+                    # the rest of the cohort a settle window to ping in.
+                    # An intact roster then continues the epoch untouched
+                    # (no resync, no cancelled in-flight ops).
+                    g = self._groups[group] = _GroupEntry(
+                        sync_id=sync_id,
+                        settling_until=now + self.settle_s,
+                        expected_members=set(members or ()),
+                    )
+                    log.info(
+                        "group %s: re-materializing epoch %s from cohort "
+                        "gossip (%d expected member(s), settling %.1fs)",
+                        group, sync_id[:8], len(g.expected_members),
+                        self.settle_s,
+                    )
+                else:
+                    g = self._groups[group] = _GroupEntry(
+                        sync_id=_new_sync_id()
+                    )
+            settling = g.settling_until is not None and now < g.settling_until
+            if settling and sync_id != g.sync_id:
+                # A peer on a different (or no) epoch pinged during
+                # adoption: the cohort is NOT intact — resync at settle.
+                g.adopt_mismatch = True
             entry = g.peers.get(peer_name)
+            if (entry is not None and incarnation is not None
+                    and entry.incarnation is not None
+                    and entry.incarnation != incarnation):
+                # Same name, new process: drop the dead incarnation's
+                # entry so the restart is a fresh join (fresh epoch) —
+                # never a silent continuation of stale rid/epoch state.
+                del g.peers[peer_name]
+                entry = None
+                g.needs_update = True
+                log.info("group %s: peer %s restarted (new incarnation)",
+                         group, peer_name)
             if entry is None:
                 entry = g.peers[peer_name] = _PeerEntry(
                     timeout=timeout,
                     sort_order=sort_order,
                     creation_order=g.creation_counter,
+                    incarnation=incarnation,
                 )
                 g.creation_counter += 1
-                g.needs_update = True
+                if not (settling and g.expected_members
+                        and peer_name in g.expected_members):
+                    g.needs_update = True
                 log.info("group %s: peer %s joined", group, peer_name)
-            entry.last_ping = time.monotonic()
+            entry.last_ping = now
             entry.timeout = timeout
             entry.synced_id = sync_id
+            if incarnation is not None:
+                entry.incarnation = incarnation
             if entry.sort_order != sort_order:
                 # Reordering is a membership-visible change: rank and tree
                 # position depend on it, so push a fresh epoch (reference
@@ -121,6 +184,58 @@ class Broker:
         pushes = []
         with self._lock:
             for group_name, g in self._groups.items():
+                if g.settling_until is not None:
+                    if now < g.settling_until:
+                        # Adopted epoch still settling: the roster is
+                        # incomplete, so neither expire, mint, nor push.
+                        continue
+                    roster = set(g.peers)
+                    if g.expected_members and (
+                        len(roster & g.expected_members)
+                        < len(g.expected_members) // 2 + 1
+                    ):
+                        # FENCING: fewer than a majority of the adopted
+                        # epoch's members have reached this broker. An
+                        # asymmetric blip can send a lone member here
+                        # while the rest of the cohort still talks to the
+                        # primary — minting a minority epoch would
+                        # split-brain training (two live cohorts, silent
+                        # divergence). Keep settling instead: pings keep
+                        # being answered with the adopted id (members
+                        # keep their last sync — safe), and arbitration
+                        # begins only once a majority has failed over
+                        # (or restarted peers re-ping in).
+                        g.settling_until = now + self.settle_s
+                        log.warning(
+                            "group %s: only %d/%d adopted members have "
+                            "reached this broker — refusing to arbitrate "
+                            "a minority epoch; still settling",
+                            group_name, len(roster & g.expected_members),
+                            len(g.expected_members),
+                        )
+                        continue
+                    g.settling_until = None
+                    intact = (
+                        not g.adopt_mismatch
+                        and g.expected_members is not None
+                        and roster == g.expected_members
+                        and all(e.synced_id == g.sync_id
+                                for e in g.peers.values())
+                    )
+                    g.expected_members = None
+                    if intact:
+                        g.needs_update = False
+                        log.info(
+                            "group %s: epoch %s adopted intact "
+                            "(%d members) — no resync",
+                            group_name, g.sync_id[:8], len(roster),
+                        )
+                    else:
+                        g.needs_update = True
+                        log.info(
+                            "group %s: roster changed across broker "
+                            "promotion — resyncing", group_name,
+                        )
                 expired = [
                     name
                     for name, e in g.peers.items()

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import secrets
 import threading
 import time
 import weakref
@@ -97,9 +98,10 @@ class AllReduce(Future):
 class _Op:
     __slots__ = ("key", "data", "op_fn", "children", "received",
                  "future", "started", "index", "members", "forwarded",
-                 "owns", "lock")
+                 "owns", "lock", "q_deadline")
 
-    def __init__(self, key, data, op_fn, index, members, future):
+    def __init__(self, key, data, op_fn, index, members, future,
+                 straggler_timeout: Optional[float] = None):
         self.key = key
         self.data = data
         self.op_fn = op_fn
@@ -117,6 +119,19 @@ class _Op:
         # first merge it is op-private and later merges may go in-place.
         self.owns = False
         self.lock = threading.Lock()  # serializes merges of this op
+        # Straggler write-off deadline (quorum rounds): an interior node
+        # past it forwards whatever partial it has instead of stalling the
+        # whole tree on one slow child. Staged by subtree height so nodes
+        # nearer the root wait longer — partials from below get a chance
+        # to arrive before the level above writes them off. Leaves never
+        # wait for anyone, so they carry no deadline.
+        if straggler_timeout is None or not self.children:
+            self.q_deadline = None
+        else:
+            h = _subtree_height(index, n)
+            self.q_deadline = self.started + float(straggler_timeout) * (
+                1.0 + 0.5 * max(0, h - 1)
+            )
 
 
 class Group:
@@ -151,8 +166,35 @@ class Group:
         self._ping_inflight = False
         self._last_broker_contact = time.monotonic()  # optimistic start
         self._broker_dark_logged = False
+        # Incarnation nonce: rides every ping so the broker can tell a
+        # restarted process reusing its old peer name from the dead
+        # incarnation it replaces (stale sequence/epoch state must never
+        # be attributed to the new process — see Broker._ping).
+        self._incarnation = secrets.token_hex(8)
+        # Broker failover: an ordered candidate list (primary first).
+        # While the current authority stays silent past the failover
+        # threshold, update() rotates to the next candidate; a standby
+        # broker re-materializes the epoch from cohort gossip (pings
+        # carry sync_id + member list) and serves within one ping
+        # interval of being adopted.
+        self._broker_candidates: List[str] = []
+        self._failover_after = 3.0 * self._PING_INTERVAL
         self._active: Dict[str, _Op] = {}
         self._parked: Dict[str, List[tuple]] = {}
+        # Results that arrived for ops we have not STARTED yet. Before
+        # quorum commits this was impossible (a result required every
+        # member's op active); now a round can commit while a stalled
+        # member has not begun its local op — dropping that share would
+        # strand the member on a sequence number the cohort has moved
+        # past, permanently. Parked results complete the op the moment
+        # it starts; stale ones age out via _expire_ops.
+        self._parked_shares: Dict[str, tuple] = {}  # key -> (result, ts)
+        # Keys whose LOCAL op already reached an outcome by expiry: a
+        # share arriving for one of these is the dead round's result —
+        # parking it would let a same-key retry complete instantly with
+        # stale data. Entries clear when the key is started again and
+        # age out with the op timeout.
+        self._expired_keys: Dict[str, float] = {}
         # Telemetry (per-Rpc registry; one source of truth for round and
         # broker-health accounting — broker_connected()/broker_silence()
         # stay as thin views over the same state the gauges read).
@@ -169,6 +211,17 @@ class Group:
         self._m_resyncs = reg.counter("group_resyncs_total", group=g)
         self._m_dark_seconds = reg.counter(
             "group_broker_dark_seconds_total", group=g
+        )
+        self._m_failovers = reg.counter(
+            "group_broker_failovers_total", group=g
+        )
+        # Quorum/straggler machinery: interior partial forwards vs root
+        # partial commits (a committed round that wrote stragglers off).
+        self._m_partial_forwards = reg.counter(
+            "group_partial_forwards_total", group=g
+        )
+        self._m_partial_commits = reg.counter(
+            "group_partial_commits_total", group=g
         )
         self._dark_mark = time.monotonic()  # last dark-time accrual point
         # Weakref: the registry outlives this Group; a strong `self` in
@@ -248,9 +301,37 @@ class Group:
         self.broker_name = str(name)
         self._ping_inflight = False
         self._last_ping = 0.0
-        # Fresh authority, fresh grace window.
+        # Fresh authority, fresh grace window (broker_dark_seconds stops
+        # accruing the moment a standby is promoted).
         self._last_broker_contact = time.monotonic()
         self._broker_dark_logged = False
+
+    def set_broker_candidates(self, names: List[str],
+                              failover_after: Optional[float] = None):
+        """Enable automatic broker failover over an ordered candidate
+        list (primary first). When the current authority has been silent
+        for ``failover_after`` seconds (default: 3 ping intervals),
+        ``update()`` rotates to the next candidate and pings it on the
+        very next tick — a live standby therefore takes over within one
+        ping interval of the switch. Rotation is cyclic, so a restarted
+        primary is retried once every standby has had its window."""
+        self._broker_candidates = [str(n) for n in names]
+        if failover_after is not None:
+            self._failover_after = float(failover_after)
+
+    def _promote_next_broker(self):
+        cands = self._broker_candidates
+        try:
+            i = cands.index(self.broker_name)
+        except ValueError:
+            i = -1
+        nxt = cands[(i + 1) % len(cands)]
+        log.warning(
+            "group %s: broker %r silent for %.1fs — failing over to %r",
+            self.group_name, self.broker_name, self.broker_silence(), nxt,
+        )
+        self._m_failovers.inc()
+        self.set_broker_name(nxt)
 
     def set_timeout(self, seconds: float):
         """Collective/membership timeout (reference: Group::setTimeout,
@@ -314,6 +395,14 @@ class Group:
         """Heartbeat; call regularly from the training loop
         (reference: GroupService::update client side, src/group.h:394-490)."""
         now = time.monotonic()
+        # Broker failover: rotate to the next candidate once the current
+        # authority has been silent past the failover threshold. Checked
+        # before the ping gate so the promotion ping goes out on THIS
+        # tick (set_broker_name re-opens the gate).
+        if (self._broker_candidates
+                and self.broker_silence() > self._failover_after):
+            self._promote_next_broker()
+            now = time.monotonic()
         # Ping-gate watchdog: a ping to a dead/restarting broker errors
         # only at the full RPC timeout (~30s), which would gate the NEXT
         # ping — and therefore rejoin after a broker restart — behind it.
@@ -336,10 +425,15 @@ class Group:
                     self._broker_dark_logged = False
 
             try:
+                # sync_id + member list are the gossip a promoted standby
+                # re-materializes the epoch from (see Broker._ping); the
+                # incarnation nonce distinguishes a restarted process
+                # reusing this peer name from its dead predecessor.
                 self.rpc.async_callback(
                     self.broker_name, "BrokerService::ping", on_pong,
                     self.group_name, self.rpc.get_name(), self.timeout,
                     self._sync_id, self.sort_order,
+                    self._incarnation, self.members,
                 )
             except BaseException:
                 # Synchronous dispatch failure (closing rpc, bad peer):
@@ -387,6 +481,12 @@ class Group:
             if old is not None:
                 for key in [k for k in self._parked if _is_current(k, old)]:
                     del self._parked[key]
+                for key in [k for k in self._parked_shares
+                            if _is_current(k, old)]:
+                    del self._parked_shares[key]
+                for key in [k for k in self._expired_keys
+                            if _is_current(k, old)]:
+                    del self._expired_keys[key]
         self._m_resyncs.inc()
         if cancelled:
             self._m_rounds_cancelled.inc(len(cancelled))
@@ -408,26 +508,45 @@ class Group:
     def _expire_ops(self):
         now = time.monotonic()
         expired = []
+        force = []
         with self._lock:
             for key, op in list(self._active.items()):
                 if now - op.started > self.timeout:
                     del self._active[key]
+                    self._expired_keys[key] = now
                     expired.append(op)
+                elif (op.q_deadline is not None and not op.forwarded
+                        and now >= op.q_deadline
+                        and op.received < len(op.children)):
+                    # Straggler deadline: write the missing children off
+                    # and move the partial along (outside this lock — the
+                    # forced forward takes op.lock first, like a merge).
+                    force.append(op)
+            for key, ts in list(self._expired_keys.items()):
+                if now - ts > self.timeout:
+                    del self._expired_keys[key]
             for key, parked in list(self._parked.items()):
                 self._parked[key] = [
                     p for p in parked if now - p[2] <= self.timeout
                 ]
                 if not self._parked[key]:
                     del self._parked[key]
+            for key, (_res, ts) in list(self._parked_shares.items()):
+                if now - ts > self.timeout:
+                    del self._parked_shares[key]
+        for op in force:
+            self._force_forward(op)
         if expired:
             self._m_rounds_expired.inc(len(expired))
             # Diagnosability under partial failure: a round that starves
             # because membership cannot heal (broker dark) reads
             # differently from one that starved under a live broker (a
-            # slow/partitioned peer).
+            # slow/partitioned peer). The CURRENT authority is named so a
+            # post-failover error points at the standby, not the corpse.
             dark = "" if self.broker_connected() else (
-                f" (broker silent for {self.broker_silence():.1f}s — "
-                "membership cannot heal until it returns)"
+                f" (broker {self.broker_name!r} silent for "
+                f"{self.broker_silence():.1f}s — membership cannot heal "
+                "until it returns)"
             )
             pool = _completion_executor()
             for op in expired:
@@ -441,7 +560,8 @@ class Group:
 
     def all_reduce(self, name: str, data: Any,
                    op: Union[str, Callable] = "sum",
-                   chunk_bytes: Optional[int] = None) -> AllReduce:
+                   chunk_bytes: Optional[int] = None,
+                   straggler_timeout: Optional[float] = None) -> AllReduce:
         """Start an async tree allreduce; returns a Future
         (reference: AllReduceService::allReduce, src/group.h:687-787).
 
@@ -451,11 +571,27 @@ class Group:
         geometry determines sub-op keys and boundaries, so it must be
         IDENTICAL on every member — pass a negotiated value (as the
         Accumulator does through its count round) when members may be
-        configured differently."""
+        configured differently.
+
+        ``straggler_timeout`` enables quorum-style partial commits: an
+        interior node that has waited past the (height-staged) deadline
+        forwards its partial sum without the missing children, and the
+        root commits whatever arrived — every member then receives the
+        SAME partial result. The group layer only provides the
+        mechanism; callers that need a K-of-N commit rule must encode
+        participation in the payload (as the Accumulator does) and
+        reject under-quorum results identically on every member.
+        Straggler ops are never chunked: a partial cut of independent
+        sub-ops could commit different participant sets per chunk.
+        Callers MUST use unique per-round op names with
+        ``straggler_timeout`` (as the Accumulator's seq/attempt-suffixed
+        keys do): a written-off child's late payload parks under the
+        round's key, and reusing that key would drain the stale payload
+        into the next round as a fresh contribution."""
         op_fn = _resolve_op(op)
         floor = _CHUNK_BYTES if chunk_bytes is None else int(chunk_bytes)
         threshold = 2 * floor if floor else (1 << 62)
-        if op_fn in _ELEMENTWISE and floor:
+        if op_fn in _ELEMENTWISE and floor and straggler_timeout is None:
             leaves = nest.flatten(data)
             if (
                 all(isinstance(x, np.ndarray) for x in leaves)
@@ -464,10 +600,12 @@ class Group:
                 return self._all_reduce_chunked(
                     name, data, leaves, op_fn, floor
                 )
-        return self._all_reduce_one(name, data, op_fn)
+        return self._all_reduce_one(name, data, op_fn,
+                                    straggler_timeout=straggler_timeout)
 
-    def _all_reduce_one(self, name: str, data: Any,
-                        op_fn: Callable) -> AllReduce:
+    def _all_reduce_one(self, name: str, data: Any, op_fn: Callable,
+                        straggler_timeout: Optional[float] = None
+                        ) -> AllReduce:
         with self._lock:
             if self._sync_id is None or not self._members:
                 raise RpcError(
@@ -481,13 +619,26 @@ class Group:
             if key in self._active:
                 raise RpcError(f"allreduce {name!r} already in flight")
             fut = AllReduce(key)
-            op_obj = _Op(key, data, op_fn, index, list(self._members), fut)
+            op_obj = _Op(key, data, op_fn, index, list(self._members), fut,
+                         straggler_timeout=straggler_timeout)
             self._active[key] = op_obj
+            # A retry of a previously-expired key starts FRESH: future
+            # shares for it are live again.
+            self._expired_keys.pop(key, None)
             parked = self._parked.pop(key, [])
+            parked_share = self._parked_shares.pop(key, None)
         # Unconditional, like every other Group counter: per-round cadence
         # costs nothing, and a telemetry toggle mid-run must not make
         # rounds_total diverge from rounds_expired/cancelled (>100% ratios).
         self._m_rounds.inc()
+        if parked_share is not None:
+            # The cohort already committed this round without us (quorum
+            # write-off while this op had not started): complete from the
+            # parked result instead of reducing toward a round that is
+            # over. _share_in pops the op, re-shares to children, and
+            # completes the future.
+            self._share_in(key, parked_share[0])
+            return fut
         # Drain early arrivals from children (reference: src/group.h:771-783).
         for p_key, payload, _ts in parked:
             self._reduce_in(p_key, payload)
@@ -645,6 +796,14 @@ class Group:
             with self._lock:
                 if self._active.get(op.key) is not op:
                     return  # cancelled/expired while queued
+                if op.forwarded:
+                    # Already sent upward (straggler write-off, or a
+                    # duplicate delivery after the normal forward): a
+                    # merge now would mutate arrays the transport may
+                    # still be serializing, and could never be forwarded
+                    # anyway. The contribution is written off at this
+                    # node; quorum callers re-contribute it next round.
+                    return
                 data, owns = op.data, op.owns
             if not (owns and _apply_inplace(op.op_fn, data, payload)):
                 data = _apply(op.op_fn, data, payload)
@@ -679,13 +838,57 @@ class Group:
                 _log_err(f"reduce->{parent}"), op.key, data,
             )
 
+    def _force_forward(self, op: _Op):
+        """Straggler write-off: forward/commit the partial sum without the
+        children that missed the deadline. Takes ``op.lock`` before the
+        group lock — the same order as a merge — so a concurrent in-place
+        merge can never be torn by the snapshot, and the ``forwarded``
+        gate it sets makes later arrivals at this node no-ops."""
+        with op.lock:
+            with self._lock:
+                if self._active.get(op.key) is not op or op.forwarded:
+                    return
+                op.forwarded = True
+                data = op.data
+                index = op.index
+                members = op.members
+                missing = len(op.children) - op.received
+        log.warning(
+            "allreduce %s: straggler deadline passed — %s without %d "
+            "child contribution(s)",
+            op.key, "committing" if index == 0 else "forwarding partial",
+            missing,
+        )
+        if index == 0:
+            self._m_partial_commits.inc()
+            self._share_in(op.key, data)
+        else:
+            self._m_partial_forwards.inc()
+            parent = members[(index - 1) // 2]
+            self.rpc.async_callback(
+                parent, "AllReduceService::reduce",
+                _log_err(f"reduce->{parent}"), op.key, data,
+            )
+
     def _share_in(self, op_key: str, result):
         """Result broadcast from the parent (reference: share,
         src/group.h:631-654)."""
         with self._lock:
             op = self._active.pop(op_key, None)
-        if op is None:
-            return
+            if op is None:
+                if op_key in self._expired_keys:
+                    # Our op for this key already FAILED at the local
+                    # timeout: this share is the dead round's result.
+                    # Parking it would hand a same-key retry a stale
+                    # answer; the caller already got its error.
+                    return
+                # A result for an op we haven't started (possible once
+                # quorum commits exist: the cohort committed without us).
+                # Park it — the op completes from here the moment our
+                # caller starts it, instead of stranding this member on a
+                # sequence the cohort has already advanced past.
+                self._parked_shares[op_key] = (result, time.monotonic())
+                return
         # Round duration: local start to result arrival (roots measure
         # the full tree reduce; leaves measure their stake in it).
         self._m_round_dur.observe(time.monotonic() - op.started)
@@ -806,6 +1009,20 @@ def _apply_inplace(op_fn, a, b) -> bool:
     for x, y in zip(la, lb):
         ufunc(x, y, out=x)
     return True
+
+
+def _subtree_height(index: int, n: int) -> int:
+    """Height of the binary-tree subtree rooted at ``index`` in an
+    ``n``-member tree (0 for a leaf). Deterministic in (index, n), so
+    every member stages the same straggler deadlines."""
+    h = 0
+    level = [index]
+    while True:
+        nxt = [c for p in level for c in (2 * p + 1, 2 * p + 2) if c < n]
+        if not nxt:
+            return h
+        h += 1
+        level = nxt
 
 
 def _group_of(op_key: str) -> str:

@@ -31,6 +31,9 @@ __all__ = [
     "scenario_drop_storm",
     "scenario_partition_heal",
     "scenario_leader_loss",
+    "scenario_learner_restart",
+    "scenario_broker_failover",
+    "scenario_straggler_quorum",
     "scenario_replica_kill",
     "scenario_router_partition",
     "SCENARIOS",
@@ -38,13 +41,29 @@ __all__ = [
 
 
 class MiniCluster:
-    """Broker + member peers, all in-process over loopback."""
+    """Broker + member peers, all in-process over loopback. With
+    ``standby=True`` a second (idle) broker peer is also started and
+    every spawned Group gets a broker-candidate list, so killing the
+    primary exercises the member-driven failover + gossip-adoption path
+    (see Broker epoch adoption)."""
 
-    def __init__(self):
+    def __init__(self, standby: bool = False,
+                 failover_after: float = 1.5):
         self.broker_rpc = Rpc("broker")
         self.broker_rpc.listen("127.0.0.1:0")
         self.addr = self.broker_rpc.debug_info()["listen"][0]
         self.broker = Broker(self.broker_rpc)
+        self.standby_rpc = None
+        self.standby = None
+        self.standby_addr = None
+        self.failover_after = failover_after
+        if standby:
+            self.standby_rpc = Rpc("broker2")
+            self.standby_rpc.listen("127.0.0.1:0")
+            self.standby_addr = self.standby_rpc.debug_info()["listen"][0]
+            self.standby = Broker(self.standby_rpc, settle_s=1.5)
+        self.brokers = [b for b in (self.broker, self.standby)
+                        if b is not None]
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -52,7 +71,8 @@ class MiniCluster:
 
     def _loop(self):
         while not self._stop.is_set():
-            self.broker.update()
+            for b in list(self.brokers):
+                b.update()
             time.sleep(0.05)
 
     def spawn(self, name: str, group: str = "g", timeout: float = 4.0):
@@ -61,8 +81,19 @@ class MiniCluster:
         rpc.connect(self.addr)
         g = Group(rpc, broker_name="broker", group_name=group,
                   timeout=timeout)
+        if self.standby_addr is not None:
+            rpc.connect(self.standby_addr)
+            g.set_broker_candidates(["broker", "broker2"],
+                                    failover_after=self.failover_after)
         self.clients.append((rpc, g))
         return rpc, g
+
+    def kill_broker(self):
+        """Kill the primary broker process (its Rpc dies; the standby —
+        if any — keeps running and takes over when members fail over)."""
+        if self.broker in self.brokers:
+            self.brokers.remove(self.broker)
+        self.broker_rpc.close()
 
     def close(self):
         self._stop.set()
@@ -71,13 +102,21 @@ class MiniCluster:
             g.close()
             rpc.close()
         self.broker_rpc.close()
+        if self.standby_rpc is not None:
+            self.standby_rpc.close()
 
 
-def _pump_accs(accs, until, timeout, what):
+def _pump_accs(accs, until, timeout, what, each=None):
+    """Drive ``update()`` on every accumulator until ``until()`` holds —
+    the one canonical poll loop for accumulator scenarios. ``each(acc)``
+    runs after each accumulator's update (apply results, contribute
+    gradients, checkpoint, ...)."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         for a in accs:
             a.update()
+            if each is not None:
+                each(a)
         if until():
             return
         time.sleep(0.005)
@@ -267,6 +306,388 @@ def scenario_leader_loss(seed: int) -> Dict[str, int]:
         plan.verify_telemetry()  # registry counters == injected log
         return plan.summary()
     finally:
+        cluster.close()
+
+
+# -- survivable training ----------------------------------------------------
+
+
+def scenario_learner_restart(seed: int, rounds: int = 12,
+                             tmpdir: "str | None" = None) -> Dict[str, int]:
+    """SIGKILL-equivalent death of a learner mid-training (its conns and
+    process die with no goodbye), followed by an immediate restart under
+    the SAME peer name: the incarnation nonce makes the broker treat the
+    restart as a fresh join (fresh epoch — the dead incarnation's
+    sequence state is never continued), the restarted peer seeds
+    ``set_model_version`` from its checkpoint so a checkpoint holder can
+    win election, fetches current model state over RPC from the leader,
+    and re-enters rounds. The run must reach the same seeded loss bar as
+    an undisturbed control run — and since every peer computes the same
+    gradient from the same params, the per-update trajectory matches the
+    control exactly (loss continuity, not merely eventual convergence).
+    The only injection is the scripted conn kill, so the event log is
+    identical for identical seeds."""
+    import tempfile
+
+    from ..parallel import Accumulator
+    from ..utils import Checkpointer
+
+    rng = np.random.RandomState(seed)
+    target = rng.uniform(-1.0, 1.0, size=(4,)).astype(np.float32)
+    lr = np.float32(0.2)
+
+    # Control trajectory: plain SGD on f(w) = ||w - target||^2 from w=0.
+    w_ctrl = np.zeros(4, np.float32)
+    for _ in range(rounds):
+        w_ctrl = w_ctrl - lr * (2.0 * (w_ctrl - target))
+    bar = float(((w_ctrl - target) ** 2).mean())
+
+    cluster = MiniCluster()
+    plan = FaultPlan(seed)
+    state: Dict[str, np.ndarray] = {}
+
+    def make_acc(name, ckpt=None):
+        rpc, g = cluster.spawn(name)
+        state.setdefault(name, np.zeros(4, np.float32))
+
+        def get_state(n=name):
+            return {"w": state[n]}
+
+        def set_state(s, n=name):
+            state[n] = np.asarray(s["w"], np.float32)
+
+        acc = Accumulator(rpc, group=g, virtual_batch_size=2,
+                          get_state=get_state, set_state=set_state)
+        if ckpt is not None:
+            saved = ckpt.load()
+            if saved is not None:
+                state[name] = np.asarray(saved["w"], np.float32)
+                # The checkpoint holder must win election over emptier
+                # peers (reference: set_model_version before joining).
+                acc.set_model_version(saved["model_version"])
+        return acc
+
+    def drive(accs, cks, until, timeout, what):
+        def step(a):
+            name = a.rpc.get_name()
+            if a.has_gradients():
+                mean, _count = a.result_gradients()
+                state[name] = np.asarray(
+                    state[name] - lr * mean["w"], np.float32
+                )
+                a.zero_gradients()
+                ck = cks.get(name)
+                if ck is not None:
+                    ck.save({"w": state[name],
+                             "model_version": a.result_model_version()})
+            elif a.wants_gradients():
+                a.reduce_gradients(
+                    {"w": 2.0 * (state[name] - target)}, batch_size=1
+                )
+
+        _pump_accs(accs, until, timeout, what, each=step)
+
+    net = None
+    with tempfile.TemporaryDirectory(dir=tmpdir) as td:
+        ck_path = td + "/learner.ckpt"
+        try:
+            accs = [make_acc(f"p{i}") for i in range(3)]
+            net = ChaosNet(plan, [a.rpc for a in accs]
+                           + [cluster.broker_rpc])
+            victim = accs[2]
+            cks = {"p2": Checkpointer(ck_path, interval=0.0)}
+            kill_at = max(2, rounds // 3)
+            drive(accs, cks, lambda: all(
+                a.model_version >= kill_at for a in accs
+            ), 30, "pre-kill training")
+
+            # SIGKILL-equivalent: connections die, process gone, no
+            # goodbye — the checkpoint on disk is all that survives.
+            net.kill_conns(victim.rpc)
+            victim.rpc.close()
+            accs = accs[:2]
+
+            # Immediate restart under the SAME name, resuming from the
+            # checkpoint (exercises the incarnation nonce: the broker
+            # must not mistake this for the dead incarnation).
+            restarted = make_acc("p2", ckpt=Checkpointer(ck_path))
+            accs.append(restarted)
+            cks = {}
+            drive(accs, cks, lambda: all(
+                a.connected() and a._synced
+                and len(a.group.members) == 3 for a in accs
+            ), 30, "restart rejoin")
+
+            drive(accs, cks, lambda: all(
+                a.model_version >= rounds for a in accs
+            ) and all(not a.has_gradients() for a in accs),
+                30, "post-restart training")
+
+            # Loss continuity: every peer (including the restarted one)
+            # converged along the control trajectory — same update rule,
+            # same params, so >= `rounds` updates means <= the control
+            # bar (the loss is monotonically contracting at this lr).
+            for a in accs:
+                w = state[a.rpc.get_name()]
+                loss = float(((w - target) ** 2).mean())
+                assert loss <= bar * 1.05 + 1e-7, (
+                    f"{a.rpc.get_name()} missed the control loss bar: "
+                    f"{loss} > {bar} (w={w}, target={target})"
+                )
+            ws = [state[a.rpc.get_name()] for a in accs]
+            for w in ws[1:]:
+                np.testing.assert_allclose(w, ws[0], rtol=1e-5, atol=1e-6)
+            # Replay determinism: the only injection is the scripted kill.
+            assert [e.kind for e in plan.events] == ["conn_kill"], (
+                f"unexpected injected-event log: {plan.events}"
+            )
+            plan.verify_telemetry()  # registry counters == injected log
+            return plan.summary()
+        finally:
+            if net is not None:
+                net.detach_all()
+            cluster.close()
+
+
+def scenario_broker_failover(seed: int) -> Dict[str, int]:
+    """Kill the broker while a collective is in flight: members rotate to
+    the standby within the failover threshold, the standby
+    re-materializes the epoch from cohort gossip (same sync id — no
+    resync, so the in-flight op completes instead of being cancelled),
+    ``broker_dark_seconds`` stops accruing after promotion, and a
+    post-promotion allreduce completes. The only injection is the
+    scripted conn kill, so the event log is identical for identical
+    seeds."""
+    cluster = MiniCluster(standby=True, failover_after=2.5)
+    plan = FaultPlan(seed)
+    net = ChaosNet(plan, [cluster.broker_rpc, cluster.standby_rpc])
+    try:
+        peers = [cluster.spawn(f"p{i}", timeout=8.0) for i in range(3)]
+        for rpc, g in peers:
+            net.attach(rpc)
+            # A grace shorter than the failover threshold (but longer
+            # than the ping cadence) so the pre-promotion window REGISTERS
+            # as dark — the accrual-stops-at-promotion check needs a
+            # nonzero baseline.
+            g.set_broker_grace(1.2)
+        groups = [g for _, g in peers]
+        _pump_groups(groups, 3)
+        sync_before = groups[0].sync_id
+        futs = [g.all_reduce("pre", np.ones(2)) for g in groups]
+        for f in futs:
+            assert float(f.result(timeout=10)[0]) == 3.0
+
+        # Strand an op in flight: every member but the last contributes,
+        # then the broker dies. The op must SURVIVE the promotion (same
+        # epoch) and complete once the last member joins in.
+        inflight = [g.all_reduce("inflight", np.ones(2))
+                    for g in groups[:-1]]
+        net.kill_conns(cluster.broker_rpc)
+        cluster.kill_broker()
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            for g in groups:
+                g.update()
+            if all(g.broker_name == "broker2" and g.broker_connected()
+                   for g in groups):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(
+                "members never promoted the standby: "
+                + str([(g.broker_name, g.broker_silence()) for g in groups])
+            )
+        reg0 = peers[0][0].telemetry.registry
+        assert (reg0.value("group_broker_failovers_total", group="g")
+                or 0) >= 1, "promotion did not count a failover"
+        dark_total = reg0.value("group_broker_dark_seconds_total", group="g")
+        assert dark_total and dark_total > 0, (
+            "the dark window before promotion must accrue dark seconds"
+        )
+
+        # Complete the stranded op across the promotion.
+        inflight.append(groups[-1].all_reduce("inflight", np.ones(2)))
+        for f in inflight:
+            out = f.result(timeout=10)
+            assert float(out[0]) == 3.0, (
+                f"in-flight op did not survive the promotion: {out}"
+            )
+
+        # The standby adopted the epoch from gossip: give its settle
+        # window time to close, then check nothing was resynced and the
+        # dark counter stopped accruing.
+        _await(lambda: _settled(groups, sync_before), 15,
+               "standby never finished adopting the epoch")
+        d1 = reg0.value("group_broker_dark_seconds_total", group="g")
+        end = time.monotonic() + 1.0
+        while time.monotonic() < end:
+            for g in groups:
+                g.update()
+            time.sleep(0.02)
+        assert all(g.sync_id == sync_before for g in groups), (
+            "promotion minted a new epoch despite an intact roster"
+        )
+        for rpc, _g in peers:
+            cancelled = rpc.telemetry.registry.value(
+                "group_rounds_cancelled_total", group="g")
+            assert not cancelled, (
+                f"promotion cancelled in-flight ops on {rpc.get_name()}"
+            )
+        after = reg0.value("group_broker_dark_seconds_total", group="g")
+        # Steadily-accruing would add ~1.0s over the settle pump; allow a
+        # scheduler-blip fraction of it but not wholesale accrual.
+        assert after - d1 < 0.5, (
+            f"broker_dark_seconds kept accruing after promotion: "
+            f"{d1} -> {after} (pre-promotion window accrued {dark_total})"
+        )
+
+        futs = [g.all_reduce("post", np.ones(2)) for g in groups]
+        for f in futs:
+            assert float(f.result(timeout=10)[0]) == 3.0
+
+        assert [e.kind for e in plan.events] == ["conn_kill"], (
+            f"unexpected injected-event log: {plan.events}"
+        )
+        plan.verify_telemetry()  # registry counters == injected log
+        return plan.summary()
+    finally:
+        net.detach_all()
+        cluster.close()
+
+
+def _settled(groups, sync_id):
+    for g in groups:
+        g.update()
+    return all(g.sync_id == sync_id and g.broker_connected()
+               for g in groups)
+
+
+def scenario_straggler_quorum(seed: int) -> Dict[str, int]:
+    """One member's outbound data-plane traffic crawls (a slow link):
+    with ``min_quorum=2`` the cohort commits gradient rounds with N-1
+    contributions at the straggler deadline — well before the collective
+    timeout — the straggler (which still receives results on time) sees
+    its contribution was written off and re-contributes it, and once the
+    link heals every contribution lands EXACTLY once on every member.
+    Delay verdicts depend on live message cadence, so this scenario
+    asserts invariants plus decision-level telemetry consistency rather
+    than an exact log (like router_partition; docs/reliability.md)."""
+    from ..parallel import Accumulator
+
+    cluster = MiniCluster()  # group timeout 4s
+    plan = FaultPlan(seed)
+    state: Dict[str, np.ndarray] = {}
+    applied: Dict[str, np.ndarray] = {}
+    net = slow_net = None
+    try:
+        accs = []
+        for i in range(3):
+            rpc, g = cluster.spawn(f"p{i}")
+            name = rpc.get_name()
+            state[name] = np.zeros(3, np.float32)
+            applied[name] = np.zeros(3, np.float64)
+
+            def get_state(n=name):
+                return {"w": state[n]}
+
+            def set_state(s, n=name):
+                state[n] = np.asarray(s["w"], np.float32)
+
+            accs.append(Accumulator(
+                rpc, group=g, virtual_batch_size=2,
+                min_quorum=2, straggler_timeout=0.5,
+                get_state=get_state, set_state=set_state,
+            ))
+        net = ChaosNet(plan, [a.rpc for a in accs] + [cluster.broker_rpc])
+        # Straggler write-offs arm only once the quorum negotiation has
+        # landed (first count-round commit) — wait for it before slowing
+        # the link, so the write-off path (not broker expiry) is what
+        # this scenario exercises.
+        _pump_accs(accs, lambda: all(
+            a.connected() and a.wants_gradients()
+            and a.get_gradient_stats()["negotiated_quorum"] == 2
+            for a in accs
+        ), 25, "initial sync + quorum negotiation")
+
+        members = accs[0].group.members
+        straggler = next(a for a in accs
+                         if a.rpc.get_name() == members[-1])
+        fast = [a for a in accs if a is not straggler]
+        weights = {m: w for m, w in zip(members, (1.0, 10.0, 100.0))}
+        total = sum(weights.values())
+
+        # One-way slow link, installed on the straggler's Rpc only: its
+        # OUTBOUND collective messages crawl (written off at the
+        # straggler deadline) while results still reach it on time, so
+        # it stays in sequence and observes every commit it missed.
+        slow_plan = FaultPlan(seed + 1)
+        for a in fast:
+            slow_plan.delay("AllReduceService::*", seconds=1.2,
+                            direction="send", peer=a.rpc.get_name())
+        slow_net = ChaosNet(slow_plan, [straggler.rpc])
+
+        def apply_result(a):
+            if a.has_gradients():
+                mean, count = a.result_gradients()
+                applied[a.rpc.get_name()] += (
+                    np.asarray(mean["w"], np.float64) * count
+                )
+                a.zero_gradients()
+
+        def pump_apply(until, timeout, what):
+            _pump_accs(accs, until, timeout, what, each=apply_result)
+
+        for a in accs:
+            w = weights[a.rpc.get_name()]
+            a.reduce_gradients({"w": np.full((3,), w, np.float32)},
+                               batch_size=2)
+        t0 = time.monotonic()
+        fast_mass = sum(weights[a.rpc.get_name()] for a in fast)
+        pump_apply(lambda: all(
+            np.allclose(applied[a.rpc.get_name()], fast_mass)
+            for a in fast
+        ), 10, "quorum commit with N-1 contributions")
+        commit_latency = time.monotonic() - t0
+        assert commit_latency < 4.0, (
+            f"quorum round took {commit_latency:.2f}s — it must beat the "
+            "4s collective timeout (straggler deadline is 0.5s)"
+        )
+        for a in fast:
+            part = a.get_gradient_stats()["last_participation"]
+            assert part == (2, 3), (
+                f"expected an N-1 commit, got participation {part}"
+            )
+            reg = a.rpc.telemetry.registry
+            assert (reg.value("acc_partial_gradient_rounds_total")
+                    or 0) >= 1, "partial gradient round not counted"
+        # The straggler observed the commit it missed and re-pended.
+        pump_apply(lambda: straggler.get_gradient_stats()[
+            "recontributed"] >= 1, 10, "straggler re-contribution")
+
+        slow_net.detach_all()  # the link heals
+        pump_apply(lambda: all(
+            np.allclose(applied[n], total) for n in applied
+        ), 25, "late contribution lands exactly once after heal")
+        # Settle: a few more count rounds must not double-apply anything.
+        end = time.monotonic() + 1.0
+        pump_apply(lambda: time.monotonic() >= end, 5, "settle")
+        for n, mass in applied.items():
+            np.testing.assert_allclose(
+                mass, total, rtol=1e-6,
+                err_msg=f"{n}: contribution applied twice or lost"
+            )
+        kinds = {e.kind for e in slow_plan.events}
+        assert kinds <= {"delay"}, kinds
+        assert plan.events == [], plan.events
+        plan.verify_telemetry()
+        slow_plan.verify_telemetry()
+        return {**plan.summary(), **slow_plan.summary()}
+    finally:
+        if slow_net is not None:
+            slow_net.detach_all()
+        if net is not None:
+            net.detach_all()
         cluster.close()
 
 
@@ -626,6 +1047,9 @@ SCENARIOS = {
     "drop_storm": scenario_drop_storm,
     "partition_heal": scenario_partition_heal,
     "leader_loss": scenario_leader_loss,
+    "learner_restart": scenario_learner_restart,
+    "broker_failover": scenario_broker_failover,
+    "straggler_quorum": scenario_straggler_quorum,
     "replica_kill": scenario_replica_kill,
     "router_partition": scenario_router_partition,
 }

@@ -4,7 +4,8 @@ not pay JAX initialization cost (see moolib_tpu/__init__.py)."""
 
 import importlib
 
-from .checkpoint import Checkpointer, load_checkpoint, save_checkpoint
+from .checkpoint import (CheckpointError, Checkpointer, load_checkpoint,
+                         save_checkpoint)
 from .jaxenv import ensure_platforms
 from .logging import get_logger, set_log_level, set_logging
 from .stats import StatMax, StatMean, StatSum, Stats
@@ -21,6 +22,7 @@ __all__ = [
     "Stats",
     "Ewma",
     "Timer",
+    "CheckpointError",
     "Checkpointer",
     "save_checkpoint",
     "load_checkpoint",

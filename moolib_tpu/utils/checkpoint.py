@@ -15,19 +15,36 @@ pytrees (params, optax states, plain dicts).
 
 from __future__ import annotations
 
+import asyncio
+import concurrent.futures
+import glob
 import os
 import pickle
 import tempfile
 import time
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 from .logging import get_logger
 
 log = get_logger("checkpoint")
 
-__all__ = ["save_checkpoint", "load_checkpoint", "Checkpointer"]
+__all__ = [
+    "CheckpointError",
+    "save_checkpoint",
+    "load_checkpoint",
+    "Checkpointer",
+]
 
 _MAGIC = "moolib_tpu.checkpoint.v1"
+
+
+class CheckpointError(ValueError):
+    """A checkpoint file exists but cannot be loaded (truncated, bit-rot,
+    wrong magic, or an unpicklable payload). Subclasses ValueError so
+    pre-existing ``except ValueError`` callers keep working; a MISSING
+    file is not a CheckpointError (``load_checkpoint`` raises the usual
+    ``FileNotFoundError`` so absence stays distinguishable from
+    corruption)."""
 
 
 def _to_host(tree: Any) -> Any:
@@ -67,11 +84,30 @@ def save_checkpoint(path: str, state: Any) -> None:
 
 def load_checkpoint(path: str) -> Any:
     """Read a checkpoint written by :func:`save_checkpoint`; returns the
-    state pytree with numpy leaves."""
+    state pytree with numpy leaves.
+
+    A file that exists but cannot be decoded — truncated write, flipped
+    bits, a non-checkpoint pickle, or the wrong magic — raises the typed
+    :class:`CheckpointError` rather than whatever the pickle layer threw,
+    so restart paths can fall back (see :meth:`Checkpointer.load`)
+    without catching bare ``Exception``. A missing file still raises
+    ``FileNotFoundError``."""
     with open(path, "rb") as f:
-        payload = pickle.load(f)
+        try:
+            payload = pickle.load(f)
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            raise  # never swallow task cancellation
+        except Exception as e:
+            # pickle surfaces corruption as a zoo of exception types
+            # (UnpicklingError, EOFError, UnicodeDecodeError, attribute
+            # lookup failures...); collapse them into the typed error.
+            raise CheckpointError(
+                f"{path} is corrupt or truncated: {type(e).__name__}: {e}"
+            ) from e
     if not (isinstance(payload, dict) and payload.get("magic") == _MAGIC):
-        raise ValueError(f"{path} is not a moolib_tpu checkpoint")
+        raise CheckpointError(f"{path} is not a moolib_tpu checkpoint")
+    if "state" not in payload:
+        raise CheckpointError(f"{path} carries no state payload")
     return payload["state"]
 
 
@@ -120,7 +156,42 @@ class Checkpointer:
             self._last_history = now
             log.info("saved history checkpoint to %s", hist)
 
+    def history_paths(self) -> List[str]:
+        """Versioned history copies for this checkpoint, newest first
+        (ordered by the timestamp embedded in the filename)."""
+        base, ext = os.path.splitext(self.path)
+        # glob.escape: a checkpoint path containing glob metacharacters
+        # ("run[1]/model.ckpt") must not silently disable the fallback.
+        pattern = f"{glob.escape(base)}-*{glob.escape(ext or '.ckpt')}"
+        out = []
+        for p in glob.glob(pattern):
+            stamp = os.path.splitext(os.path.basename(p))[0].rsplit("-", 1)[-1]
+            if stamp.isdigit():
+                out.append((int(stamp), p))
+        return [p for _stamp, p in sorted(out, reverse=True)]
+
     def load(self) -> Optional[Any]:
-        if not os.path.exists(self.path):
-            return None
-        return load_checkpoint(self.path)
+        """Load the primary checkpoint; on corruption (typed
+        :class:`CheckpointError`) fall back through the history copies,
+        newest first, and only re-raise the primary's error when no valid
+        copy exists anywhere. Returns None when nothing was ever saved —
+        absence is a fresh start, corruption-with-no-fallback is loud."""
+        primary_error: Optional[CheckpointError] = None
+        if os.path.exists(self.path):
+            try:
+                return load_checkpoint(self.path)
+            except CheckpointError as e:
+                primary_error = e
+                log.error("checkpoint %s unreadable (%s); trying history",
+                          self.path, e)
+        for hist in self.history_paths():
+            try:
+                state = load_checkpoint(hist)
+            except CheckpointError as e:
+                log.error("history checkpoint %s unreadable (%s)", hist, e)
+                continue
+            log.warning("recovered state from history checkpoint %s", hist)
+            return state
+        if primary_error is not None:
+            raise primary_error
+        return None

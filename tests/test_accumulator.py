@@ -440,3 +440,147 @@ def test_get_leader_and_set_virtual_batch_size(cluster):
     # clobber the first one's AccumulatorService handlers (same fid).
     with pytest.raises(RuntimeError, match="already registered"):
         Accumulator(cluster.clients[0][0])
+
+
+def test_quorum_round_commits_without_stalled_member(cluster):
+    """ISSUE 11 tentpole: with min_quorum=2 a stalled member no longer
+    fails the gradient round at the collective timeout — the cohort
+    commits with K-of-N contributions at the straggler deadline, the
+    mean divides by the PARTICIPATING sample count, and participation
+    telemetry records the write-off."""
+    accs = [_spawn_acc(cluster, f"q{i}", vbs=2, min_quorum=2,
+                       straggler_timeout=0.5) for i in range(3)]
+    # Wait for the first count round to COMMIT (not just for sync):
+    # straggler write-offs arm only once the quorum negotiation has
+    # landed, so the stall must begin after it.
+    _pump(accs, lambda: all(
+        a.connected() and a.wants_gradients()
+        and a.get_gradient_stats()["negotiated_quorum"] == 2
+        for a in accs
+    ))
+    members = accs[0].group.members
+    stalled = next(a for a in accs if a.rpc.get_name() == members[-1])
+    fast = [a for a in accs if a is not stalled]
+    for a in fast:
+        a.reduce_gradients({"w": np.full((3,), 4.0)}, batch_size=2)
+    t0 = time.monotonic()
+    # The stalled member stops pumping update() entirely: it neither
+    # starts its count round nor ships a bundle.
+    _pump(fast, lambda: all(a.has_gradients() for a in fast), timeout=10)
+    assert time.monotonic() - t0 < 5.0, (
+        "quorum commit must beat the 5s collective timeout"
+    )
+    for a in fast:
+        mean, count = a.result_gradients()
+        assert count == 4, count
+        np.testing.assert_allclose(np.asarray(mean["w"]), 2.0)
+        stats = a.get_gradient_stats()
+        assert stats["last_participation"] == (2, 3), stats
+        assert stats["straggler_writeoffs"] >= 1, stats
+        assert a.rpc.telemetry.registry.value(
+            "acc_partial_gradient_rounds_total") >= 1
+
+
+def test_same_name_restart_not_mistaken_for_dead_incarnation(cluster):
+    """ISSUE 11 satellite: a peer killed and IMMEDIATELY restarted under
+    its old name must not be mistaken for the dead incarnation (whose
+    sequence/epoch state is gone) — the incarnation nonce in the ping
+    makes the broker treat the restart as a fresh join, so a fresh epoch
+    forms and the cohort reduces again instead of deadlocking on
+    mismatched round sequences."""
+    accs = [_spawn_acc(cluster, f"r{i}", vbs=3) for i in range(3)]
+    _pump(accs, lambda: all(
+        a.connected() and a.wants_gradients() for a in accs
+    ))
+    # Advance the survivors' sequence state past zero.
+    for a in accs:
+        a.reduce_gradients({"w": np.ones((2,))}, batch_size=1)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs))
+    for a in accs:
+        a.zero_gradients()
+
+    victim = accs[2]
+    old_sync = victim.group.sync_id
+    victim.rpc.close()  # SIGKILL-equivalent: no goodbye, no broker leave
+    survivors = accs[:2]
+    # Immediate same-name restart — well inside the broker's expiry
+    # window for the dead entry, which is exactly the trap.
+    restarted = _spawn_acc(cluster, "r2", vbs=3)
+    accs = survivors + [restarted]
+    _pump(accs, lambda: all(
+        a.connected() and len(a.group.members) == 3 for a in accs
+    ), timeout=25)
+    assert restarted.group.sync_id != old_sync, (
+        "restart must mint a fresh epoch, not silently continue the old"
+    )
+    _pump(accs, lambda: all(a.wants_gradients() for a in accs), timeout=25)
+    for a in accs:
+        a.reduce_gradients({"w": np.full((2,), 2.0)}, batch_size=1)
+    _pump(accs, lambda: all(a.has_gradients() for a in accs), timeout=25)
+    for a in accs:
+        mean, count = a.result_gradients()
+        assert count == 3
+        np.testing.assert_allclose(np.asarray(mean["w"]), 2.0)
+
+
+def test_quorum_validation():
+    import pytest as _pytest
+
+    from moolib_tpu.rpc import Rpc
+
+    rpc = Rpc("qv")
+    try:
+        with _pytest.raises(ValueError):
+            Accumulator(rpc, min_quorum=0)
+        with _pytest.raises(ValueError):
+            Accumulator(rpc, straggler_timeout=0.0)
+    finally:
+        rpc.close()
+
+
+def test_mixed_quorum_config_never_writes_off(cluster):
+    """Review fix: straggler write-offs key off the NEGOTIATED quorum
+    (strictest across members), not the local config. With one member
+    configured require-all, the negotiation yields require-all — so a
+    slow member must be WAITED OUT (the round commits with everyone,
+    within the collective timeout), never written off into a
+    perpetually-rejected partial round."""
+    accs = [
+        _spawn_acc(cluster, "x0", vbs=2, min_quorum=2,
+                   straggler_timeout=0.3),
+        _spawn_acc(cluster, "x1", vbs=2, min_quorum=2,
+                   straggler_timeout=0.3),
+        _spawn_acc(cluster, "x2", vbs=2),  # require-all
+    ]
+    # Wait for the first count round to COMMIT: the negotiation must
+    # have landed (strictest-merge with the require-all member -> 0).
+    _pump(accs, lambda: all(
+        a.connected() and a.wants_gradients()
+        and a.get_gradient_stats()["negotiated_quorum"] == 0
+        for a in accs
+    ))
+    members = accs[0].group.members
+    slow = next(a for a in accs if a.rpc.get_name() == members[-1])
+    fast = [a for a in accs if a is not slow]
+    for a in accs:
+        a.reduce_gradients({"w": np.full((2,), 3.0)}, batch_size=1)
+    # The slow member pumps rarely (~1s cadence — far past any straggler
+    # deadline, well inside the 5s collective timeout).
+    deadline = time.monotonic() + 15
+    last_slow = 0.0
+    while time.monotonic() < deadline:
+        for a in fast:
+            a.update()
+        if time.monotonic() - last_slow > 1.0:
+            slow.update()
+            last_slow = time.monotonic()
+        if all(a.has_gradients() for a in accs):
+            break
+        time.sleep(0.005)
+    for a in accs:
+        mean, count = a.result_gradients()
+        assert count == 3, count  # everyone counted: no write-off
+        np.testing.assert_allclose(np.asarray(mean["w"]), 3.0)
+        stats = a.get_gradient_stats()
+        assert stats["quorum_rejected"] == 0, stats
+        assert stats["straggler_writeoffs"] == 0, stats

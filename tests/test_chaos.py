@@ -505,3 +505,50 @@ def test_chaos_batched_define_conn_kill_no_slot_leak():
         net.detach_all()
         client.close()
         host.close()
+
+
+# ---------------------------------------------------------------------------
+# Survivable training (ISSUE 11): learner restart, broker failover,
+# straggler quorum — canonical implementations shared with the CI smoke
+# stage (moolib_tpu.testing.scenarios).
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_learner_restart_rejoins_and_hits_loss_bar(tmp_path):
+    """SIGKILL-equivalent learner death mid-training + immediate restart
+    under the SAME peer name: the incarnation nonce keeps the broker
+    from mistaking the restart for the dead incarnation, the restarted
+    peer seeds set_model_version from its checkpoint, fetches current
+    state over RPC from the leader, re-enters rounds without corrupting
+    the sequence protocol, and the run reaches the same seeded loss bar
+    as an undisturbed control run. The injected-event log is exactly the
+    scripted conn kill."""
+    from moolib_tpu.testing.scenarios import scenario_learner_restart
+
+    summary = scenario_learner_restart(seed=303, tmpdir=str(tmp_path))
+    assert summary == {"conn_kill": 1}, summary
+
+
+def test_chaos_broker_failover_promotes_standby():
+    """Broker killed with a collective in flight: members rotate to the
+    standby within the failover threshold, the standby re-materializes
+    the epoch from cohort gossip (same sync id — the in-flight op
+    completes instead of being cancelled), broker_dark_seconds stops
+    accruing after promotion, and a post-promotion allreduce completes."""
+    from moolib_tpu.testing.scenarios import scenario_broker_failover
+
+    summary = scenario_broker_failover(seed=404)
+    assert summary == {"conn_kill": 1}, summary
+
+
+def test_chaos_straggler_quorum_commit():
+    """Straggler slow-link quorum commit: with min_quorum=2 the cohort
+    commits a gradient round with N-1 contributions at the straggler
+    deadline (well before the collective timeout), the straggler
+    re-contributes the write-off, and after heal every contribution is
+    applied exactly once on every member."""
+    from moolib_tpu.testing.scenarios import scenario_straggler_quorum
+
+    summary = scenario_straggler_quorum(seed=505)
+    assert set(summary) <= {"delay"}, summary
+    assert summary.get("delay", 0) >= 1, summary

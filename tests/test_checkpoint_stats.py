@@ -18,6 +18,7 @@ from moolib_tpu.rpc.broker import Broker
 from moolib_tpu.rpc.group import Group
 from moolib_tpu.parallel.stats import GlobalStatsAccumulator
 from moolib_tpu.utils import (
+    CheckpointError,
     Checkpointer,
     StatMax,
     StatMean,
@@ -158,3 +159,101 @@ def test_global_stats_allreduce():
             assert acc.global_stats.results()["steps"] == pytest.approx(65.0)
     finally:
         cluster.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11 satellite: typed CheckpointError + history-copy fallback.
+# ---------------------------------------------------------------------------
+
+
+def _write_history(ck, states):
+    """Save each state as a history copy with increasing timestamps."""
+    t0 = time.time()
+    for i, state in enumerate(states):
+        ck.save(state, now=t0 + 1000.0 * (i + 1))
+
+
+def test_load_checkpoint_truncated_raises_typed_error(tmp_path):
+    path = str(tmp_path / "t.ckpt")
+    save_checkpoint(path, {"w": np.arange(1000, dtype=np.float32)})
+    raw = open(path, "rb").read()
+    # Truncate a REAL checkpoint mid-stream (a crash mid-write that
+    # somehow survived the atomic-rename discipline, or a torn copy).
+    open(path, "wb").write(raw[: len(raw) // 2])
+    with pytest.raises(CheckpointError):
+        load_checkpoint(path)
+    # The typed error is still a ValueError for pre-existing callers.
+    with pytest.raises(ValueError):
+        load_checkpoint(path)
+
+
+def test_load_checkpoint_bitflip_raises_typed_error(tmp_path):
+    path = str(tmp_path / "b.ckpt")
+    save_checkpoint(path, {"w": np.arange(64, dtype=np.float32)})
+    raw = bytearray(open(path, "rb").read())
+    # Flip a byte in the pickle OPCODE stream (early bytes), which is
+    # where bit-rot reliably breaks decode; payload-byte flips can decode
+    # to wrong VALUES, which no format without checksums can catch.
+    raw[10] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises((CheckpointError,)):
+        load_checkpoint(path)
+
+
+def test_load_checkpoint_wrong_magic_is_checkpoint_error(tmp_path):
+    import pickle
+
+    p = tmp_path / "m.ckpt"
+    p.write_bytes(pickle.dumps({"magic": "something.else", "state": 1}))
+    with pytest.raises(CheckpointError):
+        load_checkpoint(str(p))
+
+
+def test_load_checkpoint_missing_file_stays_file_not_found(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(str(tmp_path / "absent.ckpt"))
+
+
+def test_checkpointer_falls_back_to_most_recent_valid_history(tmp_path):
+    path = str(tmp_path / "h.ckpt")
+    ck = Checkpointer(path, interval=0.0, history_interval=0.0)
+    _write_history(ck, [{"v": 1}, {"v": 2}, {"v": 3}])
+    hist = ck.history_paths()
+    assert len(hist) == 3, hist
+
+    # Corrupt the primary: load() must recover the NEWEST valid history.
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])
+    assert ck.load() == {"v": 3}
+
+    # Newest history also corrupt: fall through to the next one.
+    raw3 = open(hist[0], "rb").read()
+    open(hist[0], "wb").write(raw3[:10])
+    assert ck.load() == {"v": 2}
+
+    # Everything corrupt: the PRIMARY's typed error surfaces (loud), not
+    # a silent fresh start.
+    for hp in hist:
+        raw_h = open(hp, "rb").read()
+        open(hp, "wb").write(raw_h[: max(1, len(raw_h) // 3)])
+    with pytest.raises(CheckpointError):
+        ck.load()
+
+    # No file at all anywhere: None (fresh start), per the old contract.
+    ck2 = Checkpointer(str(tmp_path / "never.ckpt"))
+    assert ck2.load() is None
+
+
+def test_history_fallback_with_glob_metacharacters(tmp_path):
+    """Review fix: a checkpoint path containing glob metacharacters must
+    not silently disable the history fallback (glob.escape)."""
+    d = tmp_path / "run[1]"
+    d.mkdir()
+    path = str(d / "m.ckpt")
+    ck = Checkpointer(path, interval=0.0, history_interval=0.0)
+    t0 = time.time()
+    ck.save({"v": 1}, now=t0 + 1000)
+    assert len(ck.history_paths()) == 1, ck.history_paths()
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])
+    assert ck.load() == {"v": 1}

@@ -498,3 +498,245 @@ def test_group_setter_surface(cluster):
             for _, g in cluster.clients]
     for f in futs:
         np.testing.assert_allclose(f.result(10), 3.0)
+
+
+# ---------------------------------------------------------------------------
+# Survivable training (ISSUE 11): straggler partial commits, broker
+# failover + dark-accrual semantics.
+# ---------------------------------------------------------------------------
+
+
+def test_allreduce_straggler_timeout_partial_commit(cluster):
+    """Group-layer quorum mechanism: with ``straggler_timeout`` set, a
+    member that never joins the op is written off at the (height-staged)
+    deadline and every OTHER member completes with the same partial
+    result — well before the collective timeout. The result's payload
+    carries participation (caller-encoded, Accumulator-style) so the
+    commit rule stays with the caller."""
+    import numpy as np
+
+    peers = [cluster.spawn(f"s{i}") for i in range(3)]
+    groups = [g for _, g in peers]
+    cluster.wait_members("g", 3)
+    members = groups[0].members
+    # The LAST member (a leaf) straggles: it pings but never reduces.
+    active = [g for g in groups if g.rpc.get_name() != members[-1]]
+
+    def merge(a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    t0 = time.monotonic()
+    futs = [g.all_reduce("part", (1, (g.rpc.get_name(),)), op=merge,
+                         straggler_timeout=0.4)
+            for g in active]
+    deadline = time.monotonic() + 10
+    while not all(f.done() for f in futs):
+        assert time.monotonic() < deadline
+        for g in groups:
+            g.update()  # drives the straggler sweep
+        time.sleep(0.02)
+    took = time.monotonic() - t0
+    assert took < 5.0, f"partial commit took {took:.2f}s (timeout is 5s)"
+    results = [f.result(timeout=1) for f in futs]
+    for total, names in results:
+        assert total == 2 and set(names) == {
+            g.rpc.get_name() for g in active
+        }, results
+    assert results[0] == results[1], "members disagree on the partial"
+    # The root committed partially and counted it.
+    root_rpc = next(r for r, g in peers
+                    if r.get_name() == members[0])
+    assert (root_rpc.telemetry.registry.value(
+        "group_partial_commits_total", group="g") or 0) >= 1
+
+
+def test_broker_dark_accrual_stops_after_promotion():
+    """ISSUE 11 satellite: broker_dark_seconds accrues while the primary
+    is dark, STOPS accruing once the standby is promoted, and expired-op
+    errors name the CURRENT authority (the promoted standby, once it too
+    goes dark — never the original corpse)."""
+    import numpy as np
+
+    from moolib_tpu.testing.scenarios import MiniCluster
+
+    cluster = MiniCluster(standby=True, failover_after=2.0)
+    try:
+        peers = [cluster.spawn(f"d{i}", timeout=3.0) for i in range(2)]
+        groups = [g for _, g in peers]
+        for g in groups:
+            g.set_broker_grace(1.2)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            for g in groups:
+                g.update()
+            if all(g.active() and len(g.members) == 2 for g in groups):
+                break
+            time.sleep(0.02)
+        assert all(g.active() for g in groups)
+        reg = peers[0][0].telemetry.registry
+
+        cluster.kill_broker()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            for g in groups:
+                g.update()
+            if all(g.broker_name == "broker2" and g.broker_connected()
+                   for g in groups):
+                break
+            time.sleep(0.02)
+        assert all(g.broker_name == "broker2" for g in groups), (
+            "standby never promoted"
+        )
+        dark = reg.value("group_broker_dark_seconds_total", group="g")
+        assert dark and dark > 0, "dark window must accrue dark seconds"
+        # Promoted and connected: accrual stops (a scheduler blip may add
+        # a sliver, but nothing like the 1s of wall time pumped here).
+        d1 = reg.value("group_broker_dark_seconds_total", group="g")
+        end = time.monotonic() + 1.0
+        while time.monotonic() < end:
+            for g in groups:
+                g.update()
+            time.sleep(0.02)
+        d2 = reg.value("group_broker_dark_seconds_total", group="g")
+        assert d2 - d1 < 0.5, f"still accruing after promotion: {d1}->{d2}"
+
+        # Kill the standby too (rotation disabled so the authority name
+        # stays put): an op expiring in the dark must name broker2.
+        for g in groups:
+            g.set_broker_candidates([])
+        cluster.brokers.remove(cluster.standby)
+        cluster.standby_rpc.close()
+        fut = groups[0].all_reduce("stranded", np.ones(2))
+        deadline = time.monotonic() + 15
+        while not fut.done():
+            assert time.monotonic() < deadline
+            for g in groups:
+                g.update()
+            time.sleep(0.02)
+        exc = fut.exception(timeout=1)
+        assert exc is not None and "broker2" in str(exc), (
+            f"expired-op error must name the current authority: {exc}"
+        )
+    finally:
+        cluster.close()
+
+
+def test_parked_share_rescues_late_starting_member(cluster):
+    """Review fix: a quorum round can commit while a briefly-stalled
+    member has not STARTED its local op. The result share arriving for
+    the unknown op must be PARKED (like early child reduces), so the op
+    completes the moment the member starts it — instead of the member
+    stranding on a sequence number the cohort has moved past."""
+    import numpy as np
+
+    peers = [cluster.spawn(f"ps{i}") for i in range(3)]
+    groups = [g for _, g in peers]
+    cluster.wait_members("g", 3)
+    members = groups[0].members
+    late = next(g for g in groups if g.rpc.get_name() == members[-1])
+    active = [g for g in groups if g is not late]
+
+    def merge(a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+    futs = [g.all_reduce("late", (1, (g.rpc.get_name(),)), op=merge,
+                         straggler_timeout=0.3)
+            for g in active]
+    deadline = time.monotonic() + 10
+    while not all(f.done() for f in futs):
+        assert time.monotonic() < deadline
+        for g in groups:
+            g.update()
+        time.sleep(0.02)
+    # The cohort committed without the late member; its share was parked.
+    fut_late = late.all_reduce("late", (1, (late.rpc.get_name(),)),
+                               op=merge, straggler_timeout=0.3)
+    got = fut_late.result(timeout=2)
+    assert got == futs[0].result(timeout=1), (
+        "late starter must complete from the parked result, identically"
+    )
+
+
+def test_standby_refuses_minority_epoch():
+    """Review fix (split-brain fence): when only a lone member reaches
+    the standby (asymmetric blip — the rest of the cohort still talks to
+    the primary), the standby must NOT mint a one-member epoch. It keeps
+    settling: the member keeps its last sync (safe), and arbitration
+    waits for a majority."""
+    from moolib_tpu.testing.scenarios import MiniCluster
+
+    cluster = MiniCluster(standby=True, failover_after=1.5)
+    try:
+        # Only m0 gets the candidate list — m1/m2 model members whose
+        # path to the primary (and therefore no reason to fail over)
+        # is unaffected by the blip.
+        peers = [cluster.spawn(f"m{i}") for i in range(3)]
+        groups = [g for _, g in peers]
+        groups[1].set_broker_candidates([])
+        groups[2].set_broker_candidates([])
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            for g in groups:
+                g.update()
+            if all(g.active() and len(g.members) == 3 for g in groups):
+                break
+            time.sleep(0.02)
+        sync0 = groups[0].sync_id
+        assert sync0 is not None
+
+        # The "blip": m0 alone stops hearing the primary. Simulate by
+        # killing the primary while m1/m2 simply stop pinging (they are
+        # paused — from the standby's view only m0 ever arrives).
+        cluster.kill_broker()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            groups[0].update()  # only m0 pumps: it alone fails over
+            if (groups[0].broker_name == "broker2"
+                    and groups[0].broker_connected()):
+                break
+            time.sleep(0.02)
+        assert groups[0].broker_name == "broker2"
+        # Give the standby several settle windows: it must keep the
+        # adopted epoch un-arbitrated (same sync id, full membership) —
+        # never a fresh one-member epoch for m0.
+        end = time.monotonic() + 5.0
+        while time.monotonic() < end:
+            groups[0].update()
+            time.sleep(0.02)
+        assert groups[0].sync_id == sync0, (
+            "standby arbitrated a minority epoch (split-brain risk)"
+        )
+        assert len(groups[0].members) == 3, groups[0].members
+    finally:
+        cluster.close()
+
+
+def test_expired_key_share_not_parked_for_retry(cluster):
+    """Review fix: a share arriving AFTER the local op expired is the
+    dead round's result — it must be dropped, not parked, or a same-key
+    retry would instantly complete with stale data."""
+    import numpy as np
+
+    # Two members; only one starts the op, so it strands and expires
+    # locally at the shortened timeout.
+    rpc, g = cluster.spawn("ek0")
+    rpc2, g2 = cluster.spawn("ek1")
+    cluster.wait_members("g", 2)
+    g.set_timeout(0.5)
+    fut = g.all_reduce("stranded", np.ones(2))
+    key = fut.op_key
+    deadline = time.monotonic() + 10
+    while not fut.done():
+        assert time.monotonic() < deadline
+        g.update()
+        g2.update()
+        time.sleep(0.02)
+    assert fut.exception(timeout=1) is not None  # expired locally
+    # The dead round's share arrives late: must be dropped, not parked.
+    g._share_in(key, np.full((2,), 99.0))
+    assert key not in g._parked_shares
+    # A same-key retry starts FRESH — never instantly completed with the
+    # stale result (it now waits on the other member, as it should).
+    fut2 = g.all_reduce("stranded", np.ones(2))
+    time.sleep(0.05)
+    assert not fut2.done(), "retry must not complete from a stale share"

@@ -6,23 +6,30 @@ the SAME implementations the tier-1 suite pins, so CI smoke and tests
 cannot drift) against a live in-process cluster. Two modes:
 
 - ``--smoke``: one pass over all scenarios (loss storm, partition+heal,
-  leader loss, serving replica-kill mid-load, serving router-partition),
-  bounded well under 60s, CPU-only — the CI stage wired into
-  tools/ci_check.sh. The serving pair is the ROADMAP item-3 acceptance:
-  a router + in-process replicas on OS-assigned ports, one replica
-  killed mid-load, bounded completion and a served-p99 ceiling asserted.
+  leader loss, learner SIGKILL+restart, broker kill+standby promotion,
+  straggler slow-link quorum commit, serving replica-kill mid-load,
+  serving router-partition), bounded well under 60s, CPU-only — the CI
+  stage wired into tools/ci_check.sh. The serving pair is the ROADMAP
+  item-3 acceptance: a router + in-process replicas on OS-assigned
+  ports, one replica killed mid-load, bounded completion and a
+  served-p99 ceiling asserted.
 - ``--seed N --minutes M``: the long-run soak — scenarios loop with
   seeds derived from ``N`` until the time budget is spent, so one
   invocation covers many distinct seeded schedules. Marked slow by
   nature; not part of tier-1.
+- ``--scenario GLOB`` restricts either mode to the scenarios matching
+  an fnmatch pattern (an exact name still selects just that one).
 
 Every scenario reports the plan's injected-event summary; a failure
 prints the seed that produced it and a ready replay command, which is
-all that is needed to reproduce (see docs/reliability.md).
+all that is needed to reproduce (see docs/reliability.md). The JSON
+report aggregates per-scenario wall time (``scenario_seconds``) so a
+scenario creeping toward the smoke budget is visible in CI artifacts.
 
 Usage::
 
     python tools/chaos_soak.py --smoke
+    python tools/chaos_soak.py --smoke --scenario 'broker_*'
     python tools/chaos_soak.py --seed 7 --minutes 10
 """
 
@@ -33,6 +40,7 @@ import json
 import os
 import sys
 import time
+from fnmatch import fnmatchcase
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -55,8 +63,10 @@ def main(argv=None):
                         help="soak time budget (ignored with --smoke)")
     parser.add_argument("--smoke", action="store_true",
                         help="one bounded pass over all scenarios (CI)")
-    parser.add_argument("--scenario", choices=sorted(SCENARIOS),
-                        help="restrict to one scenario")
+    parser.add_argument("--scenario",
+                        help="restrict to scenarios matching this fnmatch "
+                             "glob (e.g. 'broker_*'; an exact name works "
+                             f"too); known: {', '.join(sorted(SCENARIOS))}")
     parser.add_argument("--locktrace", action="store_true",
                         help="run under instrumented locks "
                              "(moolib_tpu.testing.locktrace): record the "
@@ -72,7 +82,16 @@ def main(argv=None):
         trace = LockTrace()
         trace.activate()
 
-    names = [args.scenario] if args.scenario else sorted(SCENARIOS)
+    if args.scenario:
+        names = sorted(n for n in SCENARIOS
+                       if fnmatchcase(n, args.scenario))
+        if not names:
+            parser.error(
+                f"--scenario {args.scenario!r} matches none of "
+                f"{sorted(SCENARIOS)}"
+            )
+    else:
+        names = sorted(SCENARIOS)
     runs = []
     ok = True
     t_start = time.monotonic()
@@ -127,11 +146,17 @@ def main(argv=None):
         else:
             print(f"locktrace: {locktrace_report['edges']} observed "
                   "lock-order edge(s), acyclic, within the static graph")
+    scenario_seconds = {}
+    for r in runs:
+        scenario_seconds[r["scenario"]] = round(
+            scenario_seconds.get(r["scenario"], 0.0) + r["seconds"], 2
+        )
     print(json.dumps({
         "ok": ok,
         "runs": len(runs),
         "failed": [r for r in runs if not r["ok"]],
         "total_seconds": round(time.monotonic() - t_start, 1),
+        "scenario_seconds": scenario_seconds,
         **({"locktrace": locktrace_report} if locktrace_report else {}),
     }))
     return 0 if ok else 1
