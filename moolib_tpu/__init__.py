@@ -42,6 +42,8 @@ _EXPORTS = {
     "EnvRunner": "moolib_tpu.envpool",
     "EnvStepper": "moolib_tpu.envpool",
     "EnvStepperFuture": "moolib_tpu.envpool",
+    "WorkerDied": "moolib_tpu.envpool",
+    "step_with_retry": "moolib_tpu.envpool",
     "Batcher": "moolib_tpu.ops",
     # observability
     "Telemetry": "moolib_tpu.telemetry",

@@ -33,12 +33,15 @@ class Budget:
     """Guardrails for one metric. ``value_min``/``value_max`` bound the
     headline value; ``quantiles`` maps a telemetry histogram series (by
     ``name`` + required label substring) to ``{p-key: ceiling-seconds}``
-    read from the attached snapshot."""
+    read from the attached snapshot; ``extra_max`` bounds named keys of
+    the result's ``extra`` dict (side measurements a benchmark computes
+    alongside its headline — e.g. the supervision-overhead fraction)."""
 
     value_min: Optional[float] = None
     value_max: Optional[float] = None
     # [(series_name, label_substring, {"p99": ceiling_s, ...}), ...]
     quantiles: List = dataclasses.field(default_factory=list)
+    extra_max: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -81,11 +84,21 @@ CPU_PROXY_BUDGETS: Dict[str, Budget] = {
         quantiles=[("batcher_fill_seconds", "perfwatch", {"p99": 1.0})],
     ),
     # Trivial-env pool: tens of thousands steps/s measured (ENVPOOL_r04);
-    # floor catches a wedged dispatch path, not a slow one.
+    # floor catches a wedged dispatch path, not a slow one. The extra
+    # ceiling is the ISSUE-12 supervision contract: the healthy-path cost
+    # of worker supervision (heartbeat writes, completion-mark scans)
+    # must stay under 5% of envpool_steps_per_s — measured as interleaved
+    # best-of A/B against a supervise=False pool inside the benchmark.
     "envpool_steps_per_s": Budget(
         value_min=500.0,
         quantiles=[("envpool_step_seconds", "", {"p99": 1.0})],
+        extra_max={"supervision_overhead_frac": 0.05},
     ),
+    # Env-tier failover: SIGKILL one worker -> first post-respawn step.
+    # Dominated by spawning a fresh interpreter (~1-3s measured on the CI
+    # container); the ceiling catches a wedged supervisor/respawn path,
+    # not a slow host.
+    "envpool_recovery_s": Budget(value_max=30.0),
     # serial.py encode/decode of a tensor-bearing payload: memcpy-bound,
     # multiple GB/s measured.
     "serial_encode_gbps": Budget(value_min=0.1),
@@ -133,6 +146,12 @@ def evaluate_budgets(
     if b.value_max is not None and v > b.value_max:
         out.append(BudgetBreach(result.metric, "value", v, b.value_max,
                                 "ceiling", result.cmd))
+    for key, ceiling in b.extra_max.items():
+        ev = (result.extra or {}).get(key)
+        if ev is not None and float(ev) > ceiling:
+            out.append(BudgetBreach(result.metric, f"extra.{key}",
+                                    float(ev), float(ceiling), "ceiling",
+                                    result.cmd))
     snap = result.telemetry or {}
     for name, label_sub, ceilings in b.quantiles:
         series = _find_series(snap, name, label_sub)

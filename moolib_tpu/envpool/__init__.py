@@ -1,4 +1,5 @@
-from .pool import EnvPool, EnvStepper, EnvStepperFuture
+from .pool import (EnvPool, EnvStepper, EnvStepperFuture, WorkerDied,
+                   step_with_retry)
 from .stepper import EnvPoolServer, RemoteEnvStepper
 
 # Import-parity alias (reference exports EnvRunner, py/moolib/__init__.py:2-45).
@@ -15,4 +16,6 @@ __all__ = [
     "EnvStepper",
     "EnvStepperFuture",
     "RemoteEnvStepper",
+    "WorkerDied",
+    "step_with_retry",
 ]

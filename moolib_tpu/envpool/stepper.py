@@ -17,14 +17,22 @@ Usage::
 
     # any peer (same or different process/host)
     stepper = RemoteEnvStepper(rpc, "env-server")   # acquires a buffer
-    fut = stepper.step(actions)                     # -> Future of step dict
-    out = fut.result()                              # obs/reward/done/stats
+    fut = stepper.step(actions)                     # -> future of step dict
+    out = fut.result(timeout=60)                    # obs/reward/done/stats
 
 Each client owns one of the pool's ``num_batches`` buffers, so clients
 double-buffer *against each other*: while client A's batch steps in the
 workers, client B's batch is in flight too (the reference gets the same
 overlap from its bufferBusy rotation, src/env.cc:273-349).
-"""
+
+Failure model (docs/reliability.md): a dead env worker surfaces to clients
+as a retry-safe ``WorkerDied:`` wire error (the serving tier's
+:func:`~moolib_tpu.serving.error_kind` taxonomy classifies it
+``worker_died``); :meth:`RemoteEnvStepper.step` futures transparently
+retry those against the same lease — the pool guarantees a retried step
+never re-steps a slice that already completed — and re-acquire the lease
+when theirs was reclaimed (``lease_timeout`` expiry after an actor died
+silently)."""
 
 from __future__ import annotations
 
@@ -37,7 +45,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..rpc import RpcError
 from ..utils import get_logger
+from .pool import _check_wait_timeout
 
 log = get_logger("envstepper")
 
@@ -62,6 +72,11 @@ class EnvPoolServer:
     owner hasn't stepped for ``lease_timeout`` seconds may be handed to a
     new client on acquire (an actor SIGKILL must not remove env capacity
     forever — elasticity is the framework's flagship property).
+
+    Worker death inside the pool maps to a retry-safe ``WorkerDied:`` wire
+    error (never a hang): the deferred reply carries the exception type as
+    its prefix, which :func:`moolib_tpu.serving.error_kind` classifies as
+    ``worker_died`` so clients know a same-lease retry is safe.
     """
 
     def __init__(self, rpc, pool, name: str = "envpool",
@@ -83,7 +98,8 @@ class EnvPoolServer:
         self._owners: dict = {}
         self._last_step: dict = {}
         self._inflight: dict = {}  # batch_index -> EnvStepperFuture
-        # Telemetry (per-Rpc registry): served-step latency + lease churn.
+        # Telemetry (per-Rpc registry): served-step latency + lease churn
+        # + the step-error taxonomy the failover path rides on.
         reg = rpc.telemetry.registry
         self._m_steps = reg.counter("envpool_served_steps_total", pool=name)
         self._m_step_dur = reg.histogram(
@@ -91,6 +107,9 @@ class EnvPoolServer:
         )
         self._m_reclaims = reg.counter(
             "envpool_lease_reclaims_total", pool=name
+        )
+        self._m_step_errors = reg.counter(
+            "envpool_served_step_errors_total", pool=name
         )
         # Weakref: the registry outlives this server; a strong `self`
         # would pin the pool's shared-memory slabs after close(), which
@@ -123,7 +142,23 @@ class EnvPoolServer:
                     f"all {self.pool.num_batches} env buffers are taken; "
                     "raise num_batches to serve more concurrent clients"
                 )
-            idx = self._free.pop(0)
+            # A buffer whose last step FAILED (WorkerDied) still carries
+            # the previous owner's repair state; handing it out as-is
+            # would make the new client's first step a same-action retry
+            # of the OLD owner's action (its action silently ignored).
+            # reset_batch forgets that state — or reports the failed
+            # batch is still settling (a surviving worker mid-step), in
+            # which case the lease is refused fast and the client
+            # re-acquires momentarily.
+            for i, cand in enumerate(self._free):
+                if self.pool.reset_batch(cand):
+                    idx = self._free.pop(i)
+                    break
+            else:
+                raise RuntimeError(
+                    "env buffers are settling after a worker failure; "
+                    "re-acquire shortly"
+                )
             self._owners[idx] = client
             self._last_step[idx] = time.monotonic()
             log.info("env buffer %d -> client %s", idx, client)
@@ -196,6 +231,9 @@ class EnvPoolServer:
             # Dispatch + bookkeeping atomically: _release's busy check under
             # this lock must always see the future belonging to the current
             # in-flight step (never busy-without-future or a stale one).
+            # pool.step raises WorkerDied synchronously while a replacement
+            # worker is respawning — the executor's error reply carries the
+            # type-name prefix, so the client's retry loop sees it typed.
             fut = self.pool.step(batch_index, np.asarray(action))
             self._inflight[batch_index] = fut
         tel_on = self.rpc.telemetry.on
@@ -218,6 +256,9 @@ class EnvPoolServer:
                 deferred.error(f"{type(e).__name__}: step cancelled")
                 raise
             except Exception as e:
+                # The type-name prefix IS the wire taxonomy: "WorkerDied:
+                # ..." classifies as worker_died (retry-safe) client-side.
+                self._m_step_errors.inc()
                 deferred.error(f"{type(e).__name__}: {e}")
 
         fut.add_done_callback(on_done)
@@ -236,19 +277,101 @@ class EnvPoolServer:
                 pass
 
 
+class _RetryingStepFuture:
+    """Future for one logical remote step, with transparent failover.
+
+    ``result()`` retries *safe* failures: ``worker_died`` wire errors
+    (the pool's exactly-once retry contract makes a same-action re-step
+    safe) and lease loss (``not owned`` — the server reclaimed the lease
+    while this client was silent; re-acquire, then re-step). Retries use
+    capped-exponential backoff and are bounded by ``max_retries`` and the
+    caller's ``result`` timeout. Follows the PR-8 ``Future`` contract:
+    ``timeout=None`` waits forever, ``0`` is a non-blocking poll (no
+    retries — retrying requires waiting), negative/non-finite raise
+    ``ValueError``."""
+
+    def __init__(self, stepper: "RemoteEnvStepper", action):
+        self._stepper = stepper
+        self._action = action
+        self._attempts = 0
+        self._fut = stepper._send(action)
+
+    def result(self, timeout: Optional[float] = None):
+        timeout = _check_wait_timeout(timeout, "step.result")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        st = self._stepper
+        while True:
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            try:
+                return self._fut.result(left)
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # never swallow task cancellation
+            except RpcError as e:
+                from ..serving import error_kind
+
+                msg = str(e)
+                st.last_error = msg
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if (self._attempts >= st.max_retries
+                        or (remaining is not None and remaining <= 0)):
+                    raise
+                if error_kind(e) == "worker_died":
+                    pass  # same lease; the pool's retry is exactly-once
+                elif "not owned" in msg or "re-acquire" in msg:
+                    st._reacquire()  # lease was reclaimed: take a new one
+                else:
+                    raise  # not a failure class a retry can fix
+                self._attempts += 1
+                st.retries_total += 1
+                delay = min(st.retry_backoff_cap,
+                            st.retry_backoff * (2 ** (self._attempts - 1)))
+                if remaining is not None:
+                    delay = min(delay, remaining)
+                time.sleep(delay)
+                self._fut = st._send(self._action)
+
+    def exception(self, timeout: Optional[float] = None):
+        timeout = _check_wait_timeout(timeout, "step.exception")
+        try:
+            self.result(timeout)
+            return None
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            raise  # never swallow task cancellation
+        except TimeoutError:
+            raise  # the WAIT timed out: the step is not done yet
+        except Exception as e:
+            return e
+
+
 class RemoteEnvStepper:
     """Client handle: step a (possibly remote) peer's EnvPool.
 
     Acquires a dedicated buffer on construction; ``step`` is asynchronous,
     so N clients (threads, processes, or hosts) overlap their batches in
-    the one pool's workers.
+    the one pool's workers. Step futures transparently retry
+    ``worker_died`` failures (same lease, same action — exactly-once by
+    the pool's repair contract) and re-acquire a reclaimed lease; pass
+    ``retry=False`` to get the raw RPC future instead.
     """
 
     def __init__(self, rpc, server: str, name: str = "envpool",
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, max_retries: int = 8,
+                 retry_backoff: float = 0.05,
+                 retry_backoff_cap: float = 1.0):
         self.rpc = rpc
         self.server = server
         self.name = name
+        self.timeout = timeout
+        self.max_retries = int(max_retries)
+        self.retry_backoff = float(retry_backoff)
+        self.retry_backoff_cap = float(retry_backoff_cap)
+        self.retries_total = 0
+        self.reacquires_total = 0
+        self.last_error: Optional[str] = None
         info = rpc.async_(server, f"{name}::info").result(timeout)
         self.batch_size = info["batch_size"]
         self.num_batches = info["num_batches"]
@@ -257,15 +380,42 @@ class RemoteEnvStepper:
         ).result(timeout)
         self._closed = False
 
-    def step(self, action):
-        """Async batched step on this client's buffer -> Future of the
-        step-result dict (obs fields, reward, done, episode stats)."""
-        if self._closed:
-            raise RuntimeError("RemoteEnvStepper is closed")
+    def _send(self, action):
         return self.rpc.async_(
             self.server, f"{self.name}::step", self.batch_index,
-            np.asarray(action), self.rpc.get_name(),
+            action, self.rpc.get_name(),
         )
+
+    def _reacquire(self):
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                self.batch_index = self.rpc.async_(
+                    self.server, f"{self.name}::acquire", self.rpc.get_name()
+                ).result(self.timeout)
+                break
+            except RpcError as e:
+                # A freed buffer can briefly refuse leases while a failed
+                # batch settles (a surviving worker mid-step) — that is a
+                # retry-in-a-moment, not a refusal.
+                if "settling" in str(e) and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                    continue
+                raise
+        self.reacquires_total += 1
+        log.warning("lease re-acquired: env buffer %d", self.batch_index)
+
+    def step(self, action, *, retry: bool = True):
+        """Async batched step on this client's buffer -> future of the
+        step-result dict (obs fields, reward, done, episode stats). With
+        ``retry=True`` (default) the future fails over per the class
+        docstring; ``retry=False`` returns the raw RPC future."""
+        if self._closed:
+            raise RuntimeError("RemoteEnvStepper is closed")
+        action = np.asarray(action)
+        if not retry:
+            return self._send(action)
+        return _RetryingStepFuture(self, action)
 
     def close(self):
         if not self._closed:

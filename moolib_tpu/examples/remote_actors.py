@@ -270,8 +270,15 @@ def run_actor(cfg: RemoteConfig, learner_addr: str,
             try:
                 for i in range(2):
                     # Bounded wait: a dead env worker must surface as an
-                    # error here, not hang the actor forever.
-                    out = futures[i].result(timeout=300.0)
+                    # error here, not hang the actor forever. WorkerDied
+                    # is retry-safe (supervised respawn + exactly-once
+                    # same-action retry), so the actor keeps acting.
+                    try:
+                        out = futures[i].result(timeout=300.0)
+                    except moolib_tpu.WorkerDied:
+                        out = moolib_tpu.step_with_retry(
+                            pool, i, actions[i], timeout=300.0
+                        )
                     unroll = bs[i].observe(out)
                     if unroll is not None:
                         # Ship the completed unroll; keep at most one in

@@ -405,8 +405,16 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
             # -- acting (double-buffered) -----------------------------------
             for i in range(cfg.num_actor_batches):
                 # Bounded wait: a dead env worker must surface as an
-                # error, not hang the acting loop forever.
-                out = futures[i].result(timeout=300.0)
+                # error, not hang the acting loop forever. WorkerDied is
+                # the RETRY-SAFE class (pool supervision respawns the
+                # worker; same-action retry is exactly-once per env), so
+                # training survives an actor-process death mid-run.
+                try:
+                    out = futures[i].result(timeout=300.0)
+                except moolib_tpu.WorkerDied:
+                    out = moolib_tpu.step_with_retry(
+                        pool, i, actions[i], timeout=300.0
+                    )
                 bs = batch_states[i]
                 unroll = bs.observe(out)
                 if unroll is not None:

@@ -54,10 +54,14 @@ def error_kind(exc_or_msg: Any) -> str:
 
     Returns one of ``"overloaded"`` (never executed — retry elsewhere is
     always safe), ``"deadline"`` (budget gone — do not retry),
-    ``"conn"`` (connection lost / peer unroutable — retry is safe iff
-    the endpoint is idempotent), ``"timeout"`` (expired in flight — may
-    have executed; retry iff idempotent), ``"not_found"`` (endpoint or
-    peer misconfigured — retrying cannot help), or ``"other"``.
+    ``"worker_died"`` (an env-tier worker died or was watchdog-killed —
+    always safe to retry against the same pool: the retried step
+    re-dispatches only the slices that never completed, see
+    :class:`moolib_tpu.envpool.WorkerDied`), ``"conn"`` (connection lost
+    / peer unroutable — retry is safe iff the endpoint is idempotent),
+    ``"timeout"`` (expired in flight — may have executed; retry iff
+    idempotent), ``"not_found"`` (endpoint or peer misconfigured —
+    retrying cannot help), or ``"other"``.
     Accepts the typed exceptions or the wire's error strings."""
     if isinstance(exc_or_msg, Overloaded):
         return "overloaded"
@@ -68,6 +72,8 @@ def error_kind(exc_or_msg: Any) -> str:
         return "overloaded"
     if msg.startswith("DeadlineExceeded:"):
         return "deadline"
+    if msg.startswith("WorkerDied:") or type(exc_or_msg).__name__ == "WorkerDied":
+        return "worker_died"
     if "expired in the server queue" in msg:
         return "deadline"
     if ("connection to" in msg and "lost" in msg) or "no route to" in msg:

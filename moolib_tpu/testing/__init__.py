@@ -6,11 +6,11 @@ never pays for (or accidentally enables) chaos machinery; see
 :mod:`moolib_tpu.testing.chaos` and :mod:`moolib_tpu.testing.locktrace`.
 """
 
-from .chaos import ChaosNet, Event, FaultPlan
+from .chaos import ChaosNet, Event, FaultPlan, ProcChaos, ProcFaultPlan
 from .locktrace import LockOrderViolation, LockTrace
 
 __all__ = ["ChaosNet", "Event", "FaultPlan", "LockOrderViolation",
-           "LockTrace", "SCENARIOS"]
+           "LockTrace", "ProcChaos", "ProcFaultPlan", "SCENARIOS"]
 
 
 def __getattr__(name):

@@ -66,7 +66,8 @@ from ..utils import get_logger
 
 log = get_logger("chaos")
 
-__all__ = ["Event", "FaultPlan", "ChaosNet", "CONTROL_NAMES"]
+__all__ = ["Event", "FaultPlan", "ChaosNet", "CONTROL_NAMES",
+           "ProcFaultPlan", "ProcChaos"]
 
 #: Control-plane fids get stable ``@``-prefixed endpoint names so rules can
 #: target them by pattern (e.g. ``blackhole_keepalive`` drops "@keepalive").
@@ -388,6 +389,87 @@ class FaultPlan:
                 f"telemetry fault counters diverge from the injected-event "
                 f"log: registry={got} events={want}"
             )
+
+
+class ProcFaultPlan(FaultPlan):
+    """Seeded plan for PROCESS-level faults against the env-worker tier —
+    the ``testing.chaos`` discipline extended below the wire: target
+    selection is pure in the seed (:meth:`pick`), every applied action
+    lands in the same replayable ordered event log as the wire faults
+    (``proc_kill`` / ``proc_stop`` / ``proc_cont`` / ``proc_raise``
+    events, mirrored into ``chaos_injected_total{kind}`` so
+    :meth:`verify_telemetry` covers them), and a failing scenario
+    reproduces from its seed alone.
+
+    The plan only *decides*; :class:`ProcChaos` applies the decisions to
+    a live :class:`~moolib_tpu.envpool.EnvPool`'s worker slots.
+    """
+
+    def pick(self, n: int) -> int:
+        """Seeded target draw in ``[0, n)`` — THE decision primitive:
+        pure in (seed, sequence of ``pick`` calls), like
+        :meth:`FaultPlan.decide` for wire faults."""
+        if n < 1:
+            raise ValueError(f"pick(n) needs n >= 1, got {n!r}")
+        with self._lock:
+            return self._rng.randrange(int(n))
+
+
+class ProcChaos:
+    """Applies a :class:`ProcFaultPlan`'s decisions to a live EnvPool.
+
+    Worker slots are addressed by index — after a respawn the slot
+    addresses the *replacement* process, so a plan can keep injecting
+    into the same logical slice. Faults:
+
+    - :meth:`kill` — SIGKILL (worker death: exit class),
+    - :meth:`wedge` / :meth:`resume` — SIGSTOP / SIGCONT (the hung-step
+      watchdog's class; SIGKILL terminates stopped processes, so a
+      wedged worker needs no resume before the watchdog reaps it),
+    - :meth:`inject_exception` — SIGUSR1, raised in-process as an
+      uncatchable crash (the unpickleable-env-crash class).
+    """
+
+    def __init__(self, plan: ProcFaultPlan, pool):
+        self.plan = plan
+        self.pool = pool
+
+    def _apply(self, slot: int, sig, kind: str, action: str) -> None:
+        import os
+
+        pid = self.pool._procs[slot].pid
+        os.kill(pid, sig)
+        with self.plan._lock:
+            self.plan._log_locked(kind, action, None, f"worker{slot}",
+                                  None, None, slot)
+
+    def kill(self, slot: int) -> None:
+        """SIGKILL the worker in ``slot`` (supervised death + respawn)."""
+        import signal as _signal
+
+        self._apply(slot, _signal.SIGKILL, "proc_kill", "kill")
+
+    def wedge(self, slot: int) -> None:
+        """SIGSTOP the worker in ``slot`` — the hung-step watchdog must
+        distinguish it from a merely slow worker and reap it."""
+        import signal as _signal
+
+        self._apply(slot, _signal.SIGSTOP, "proc_stop", "stop")
+
+    def resume(self, slot: int) -> None:
+        """SIGCONT a previously wedged worker (heal before the watchdog
+        fires — the slow-but-alive branch of the scenario space)."""
+        import signal as _signal
+
+        self._apply(slot, _signal.SIGCONT, "proc_cont", "cont")
+
+    def inject_exception(self, slot: int) -> None:
+        """Raise an uncatchable exception inside the worker via SIGUSR1
+        (``envpool.pool._InjectedCrash``): always the worker-crash class,
+        never absorbed by the poison-env quarantine guards."""
+        import signal as _signal
+
+        self._apply(slot, _signal.SIGUSR1, "proc_raise", "raise")
 
 
 class _RpcFaultHooks:

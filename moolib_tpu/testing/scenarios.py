@@ -23,9 +23,10 @@ import numpy as np
 from ..rpc import Rpc, RpcError
 from ..rpc.broker import Broker
 from ..rpc.group import Group
-from .chaos import ChaosNet, FaultPlan
+from .chaos import ChaosNet, FaultPlan, ProcChaos, ProcFaultPlan
 
 __all__ = [
+    "EnvFleet",
     "MiniCluster",
     "ServingFleet",
     "scenario_drop_storm",
@@ -36,6 +37,9 @@ __all__ = [
     "scenario_straggler_quorum",
     "scenario_replica_kill",
     "scenario_router_partition",
+    "scenario_envpool_worker_kill",
+    "scenario_envpool_wedge",
+    "scenario_envpool_poison",
     "SCENARIOS",
 ]
 
@@ -1024,6 +1028,307 @@ def scenario_router_partition(seed: int, *, budget_s: float = 8.0,
         fleet.close()
 
 
+# -- env tier ----------------------------------------------------------------
+
+
+class ChaosStepEnv:
+    """Deterministic env for the env-tier chaos scenarios (module-level so
+    it pickles into spawn workers): obs ``[seed, t, last_action]``,
+    episodes never terminate (so ``episode_step`` counts exactly-once
+    stepping), an optional fixed per-step sleep (so process faults land
+    mid-slice), and an optional poison index — that env raises forever
+    once ``t`` reaches ``poison_at`` (a genuinely broken env, the
+    quarantine class)."""
+
+    def __init__(self, index: int, sleep_s: float = 0.0,
+                 poison: "int | None" = None, poison_at: int = 1):
+        self.seed = index
+        self.t = 0
+        self.sleep_s = sleep_s
+        self.poison = poison
+        self.poison_at = poison_at
+        self.broken = False
+
+    def reset(self):
+        self.t = 0
+        return self._obs(-1), {}
+
+    def step(self, action):
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        if self.poison == self.seed and self.t >= self.poison_at:
+            self.broken = True  # stays broken across auto-reset attempts
+        if self.broken:
+            raise RuntimeError(f"poison env {self.seed} at t={self.t}")
+        self.t += 1
+        return self._obs(int(action)), 1.0, False, False, {}
+
+    def _obs(self, last_action):
+        return np.array([self.seed, self.t, last_action], np.float32)
+
+    def close(self):
+        pass
+
+
+class EnvFleet:
+    """EnvPool + EnvPoolServer + one RemoteEnvStepper actor client, all
+    in-process over loopback on OS-assigned ports — the canonical env-tier
+    cohort for the chaos scenarios (the served-step path is what actors
+    and, through them, the learner ride on)."""
+
+    def __init__(self, create_env, *, procs: int, batch_size: int,
+                 pool_name: str, watchdog_timeout: float = 5.0,
+                 restart_backoff: float = 0.05,
+                 poison_threshold: int = 3):
+        from ..envpool import EnvPool, EnvPoolServer, RemoteEnvStepper
+
+        self.pool = EnvPool(
+            create_env, num_processes=procs, batch_size=batch_size,
+            num_batches=2, name=pool_name,
+            watchdog_timeout=watchdog_timeout,
+            restart_backoff=restart_backoff,
+            poison_threshold=poison_threshold,
+        )
+        self.server_rpc = Rpc("env-server")
+        self.server_rpc.listen("127.0.0.1:0")
+        self.server = EnvPoolServer(self.server_rpc, self.pool)
+        self.client_rpc = Rpc("actor0")
+        self.client_rpc.connect(self.server_rpc.debug_info()["listen"][0])
+        self.stepper = RemoteEnvStepper(self.client_rpc, "env-server")
+
+    def close(self):
+        self.stepper.close()
+        self.client_rpc.close()
+        self.server.close()
+        self.server_rpc.close()
+        self.pool.close()
+
+
+def _reg_delta(reg, name, base, **labels):
+    return (reg.value(name, **labels) or 0) - base
+
+
+def scenario_envpool_worker_kill(seed: int, *, procs: int = 3,
+                                 batch_size: int = 6,
+                                 steps: int = 12) -> Dict[str, int]:
+    """SIGKILL 1-of-N env workers mid-batch (the seeded slot): only that
+    worker's in-flight slices error — fast and typed (``WorkerDied:``,
+    retry-safe), the surviving slices are served from their already-written
+    results exactly once (no env steps twice across the retry), the pool
+    respawns the slot within the restart budget, post-respawn steps/s
+    recovers to >= 80% of the pre-kill rate (the env's fixed per-step
+    sleep dominates both, so the ratio is scheduler-stable), the injected
+    event log is seed-replay-identical ([proc_kill] with the seeded slot),
+    and ``verify_telemetry`` matches the plan."""
+    import functools
+
+    from ..telemetry import global_telemetry
+
+    pname = f"envkill{seed}"
+    fleet = EnvFleet(
+        functools.partial(ChaosStepEnv, sleep_s=0.01),
+        procs=procs, batch_size=batch_size, pool_name=pname,
+    )
+    plan = ProcFaultPlan(seed)
+    chaos = ProcChaos(plan, fleet.pool)
+    try:
+        st = fleet.stepper
+        a = np.zeros(batch_size, np.int64)
+        st.step(a).result(timeout=60)  # warm: every worker has stepped
+        reg = global_telemetry().registry
+        base_deaths = reg.value("envpool_worker_deaths_total",
+                                pool=pname, kind="exit") or 0
+        base_respawns = reg.value("envpool_respawns_total",
+                                  pool=pname) or 0
+
+        t0 = time.monotonic()
+        for _ in range(steps):
+            last = st.step(a).result(timeout=60)
+        pre_rate = steps / (time.monotonic() - t0)
+        pre_t = np.array(last["episode_step"], copy=True)
+
+        slot = plan.pick(procs)  # the seeded decision
+        per = batch_size // procs
+        fut = st.step(a)
+        time.sleep(0.004)  # land mid-slice (each slice takes ~per*10ms)
+        chaos.kill(slot)
+        out = fut.result(timeout=60)  # the retrying future heals
+
+        # Exactly-once across the failure: every SURVIVING slice advanced
+        # by exactly one step (their results were served, never re-run),
+        # and the killed slot's slice restarted its episodes (fresh envs).
+        lo, hi = slot * per, (slot + 1) * per
+        surv = np.ones(batch_size, bool)
+        surv[lo:hi] = False
+        post_t = np.asarray(out["episode_step"])
+        assert (post_t[surv] == pre_t[surv] + 1).all(), (
+            f"surviving slices not exactly-once: {pre_t} -> {post_t} "
+            f"(killed slot {slot})"
+        )
+        assert (post_t[lo:hi] == 1).all(), (
+            f"killed slot's respawned slice should be on its first step: "
+            f"{post_t[lo:hi]}"
+        )
+        assert st.retries_total >= 1, (
+            "the kill must surface as a typed retry-safe failure that the "
+            "stepper retried (not as a silent success)"
+        )
+        assert st.last_error and st.last_error.startswith("WorkerDied:"), (
+            f"expected a WorkerDied: wire error, got {st.last_error!r}"
+        )
+
+        # The pool recovered within the restart budget...
+        _await(lambda: _reg_delta(
+            reg, "envpool_respawns_total", base_respawns, pool=pname
+        ) >= 1, 20, "worker never respawned")
+        assert _reg_delta(reg, "envpool_worker_deaths_total", base_deaths,
+                          pool=pname, kind="exit") == 1
+        # ... and serves at >= 80% of the pre-kill rate.
+        t0 = time.monotonic()
+        for _ in range(steps):
+            st.step(a).result(timeout=60)
+        post_rate = steps / (time.monotonic() - t0)
+        assert post_rate >= 0.8 * pre_rate, (
+            f"post-respawn steps/s did not recover: {post_rate:.1f} vs "
+            f"pre-kill {pre_rate:.1f}"
+        )
+
+        # Replay determinism: decisions are pure in the seed, and the only
+        # injected action is the scripted kill of the seeded slot.
+        assert [(e.kind, e.arg) for e in plan.events] == [
+            ("proc_kill", slot)
+        ], plan.events
+        assert ProcFaultPlan(seed).pick(procs) == slot, (
+            "seeded slot draw is not replay-identical"
+        )
+        plan.verify_telemetry()  # registry counters == injected log
+        return plan.summary()
+    finally:
+        fleet.close()
+
+
+def scenario_envpool_wedge(seed: int, *, procs: int = 2,
+                           batch_size: int = 4,
+                           watchdog: float = 1.0) -> Dict[str, int]:
+    """SIGSTOP one env worker mid-step (the seeded slot): the hung-step
+    watchdog distinguishes the wedge from a merely slow worker (whose
+    heartbeat advances per env step), kills it within the watchdog
+    deadline, respawns the slot, and the wedged batch fails typed and
+    completes on retry. Event log: exactly [proc_stop]."""
+    import functools
+
+    from ..telemetry import global_telemetry
+
+    pname = f"envwedge{seed}"
+    fleet = EnvFleet(
+        functools.partial(ChaosStepEnv, sleep_s=0.03),
+        procs=procs, batch_size=batch_size, pool_name=pname,
+        watchdog_timeout=watchdog,
+    )
+    plan = ProcFaultPlan(seed)
+    chaos = ProcChaos(plan, fleet.pool)
+    try:
+        st = fleet.stepper
+        a = np.zeros(batch_size, np.int64)
+        st.step(a).result(timeout=60)
+        reg = global_telemetry().registry
+        base_wedge = reg.value("envpool_worker_deaths_total",
+                               pool=pname, kind="wedge") or 0
+
+        slot = plan.pick(procs)
+        fut = st.step(a)
+        time.sleep(0.01)  # the slice is being stepped
+        chaos.wedge(slot)
+        t_wedge = time.monotonic()
+        _await(lambda: _reg_delta(
+            reg, "envpool_worker_deaths_total", base_wedge,
+            pool=pname, kind="wedge"
+        ) >= 1, watchdog + 5.0, "watchdog never reaped the wedged worker")
+        detect_s = time.monotonic() - t_wedge
+        # Deadline + one heartbeat-arm slack + scheduler slack: a wedge
+        # must be detected promptly, not at some multiple of the deadline.
+        assert detect_s <= watchdog + 2.0, (
+            f"wedge detected after {detect_s:.2f}s (watchdog {watchdog}s)"
+        )
+        out = fut.result(timeout=60)  # typed failure absorbed by retry
+        assert out["obs"].shape[0] == batch_size
+        assert st.retries_total >= 1
+        st.step(a).result(timeout=60)  # pool serves normally again
+
+        assert [(e.kind, e.arg) for e in plan.events] == [
+            ("proc_stop", slot)
+        ], plan.events
+        assert ProcFaultPlan(seed).pick(procs) == slot
+        plan.verify_telemetry()  # registry counters == injected log
+        return plan.summary()
+    finally:
+        fleet.close()
+
+
+def scenario_envpool_poison(seed: int, *, procs: int = 2,
+                            batch_size: int = 6) -> Dict[str, int]:
+    """One env (the seeded index) raises on every step: its worker
+    quarantines it after ``poison_threshold`` consecutive failures —
+    masked out of the batch as a terminal transition, reported per env
+    index and counted in telemetry — while the worker stays alive
+    (NO death/respawn: quarantine exists so a poison env cannot
+    crash-loop its worker) and the rest of the cohort keeps stepping.
+    The plan injects nothing (the poison is in the env); its only
+    decision is the seeded index, so the event log is empty and
+    seed-identical."""
+    import functools
+
+    from ..telemetry import global_telemetry
+
+    pname = f"envpoison{seed}"
+    plan = ProcFaultPlan(seed)
+    poison = plan.pick(batch_size)  # the seeded decision
+    fleet = EnvFleet(
+        functools.partial(ChaosStepEnv, poison=poison),
+        procs=procs, batch_size=batch_size, pool_name=pname,
+        poison_threshold=2,
+    )
+    try:
+        st = fleet.stepper
+        a = np.zeros(batch_size, np.int64)
+        reg = global_telemetry().registry
+        base_q = reg.value("envpool_quarantined_total", pool=pname) or 0
+
+        def quarantined():
+            st.step(a).result(timeout=60)
+            return fleet.pool.quarantined() == (poison,)
+
+        _await(quarantined, 30, "poison env never quarantined")
+        assert _reg_delta(reg, "envpool_quarantined_total", base_q,
+                          pool=pname) == 1
+
+        # The cohort keeps training across the quarantine: healthy envs
+        # advance, the poisoned row is a terminal transition every step.
+        before = np.array(
+            st.step(a).result(timeout=60)["episode_step"], copy=True
+        )
+        for _ in range(5):
+            out = st.step(a).result(timeout=60)
+        healthy = np.ones(batch_size, bool)
+        healthy[poison] = False
+        post = np.asarray(out["episode_step"])
+        assert (post[healthy] == before[healthy] + 5).all(), (before, post)
+        assert bool(out["done"][poison]) and post[poison] == 0, (
+            f"quarantined env {poison} must read as terminal: "
+            f"done={out['done'][poison]} step={post[poison]}"
+        )
+        # Quarantine, not crash-loop: the worker never died.
+        assert (reg.value("envpool_worker_deaths_total",
+                          pool=pname, kind="exit") or 0) == 0
+        assert (reg.value("envpool_respawns_total", pool=pname) or 0) == 0
+        assert plan.events == [], plan.events
+        assert ProcFaultPlan(seed).pick(batch_size) == poison
+        plan.verify_telemetry()  # trivially: nothing injected, none counted
+        return plan.summary()
+    finally:
+        fleet.close()
+
+
 def _count_ok(outcomes, lock, start):
     with lock:
         return sum(1 for k, _l, _d in outcomes[start:] if k == "ok")
@@ -1052,4 +1357,7 @@ SCENARIOS = {
     "straggler_quorum": scenario_straggler_quorum,
     "replica_kill": scenario_replica_kill,
     "router_partition": scenario_router_partition,
+    "envpool_worker_kill": scenario_envpool_worker_kill,
+    "envpool_wedge": scenario_envpool_wedge,
+    "envpool_poison": scenario_envpool_poison,
 }

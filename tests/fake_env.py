@@ -58,3 +58,34 @@ class SlowEnv(FakeEnv):
 
         time.sleep(self.STEP_SECONDS)
         return super().step(action)
+
+
+class PoisonEnv(FakeEnv):
+    """FakeEnv whose ``step`` raises forever once t reaches POISON_AT for
+    the seeds in POISON_SEEDS — the poison-env quarantine class (the env
+    is broken, the worker must survive it)."""
+
+    POISON_SEEDS = (1,)
+    POISON_AT = 2
+
+    def step(self, action):
+        if self.seed in self.POISON_SEEDS and self.t >= self.POISON_AT:
+            self.broken = True  # stays broken across auto-reset attempts
+        if getattr(self, "broken", False):
+            raise RuntimeError(f"poison env {self.seed} at t={self.t}")
+        return super().step(action)
+
+
+class CrashEnv(FakeEnv):
+    """FakeEnv whose ``step`` hard-kills its worker process for the seeds
+    in CRASH_SEEDS — the crash-looping-worker class (every respawn dies
+    again, so the restart budget must degrade the slot to down)."""
+
+    CRASH_SEEDS = (1,)
+
+    def step(self, action):
+        if self.seed in self.CRASH_SEEDS:
+            import os
+
+            os._exit(17)
+        return super().step(action)

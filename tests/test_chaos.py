@@ -552,3 +552,92 @@ def test_chaos_straggler_quorum_commit():
     summary = scenario_straggler_quorum(seed=505)
     assert set(summary) <= {"delay"}, summary
     assert summary.get("delay", 0) >= 1, summary
+
+
+# -- survivable env tier (ISSUE 12) -------------------------------------------
+# Tier-1 wrappers over the canonical env-tier scenarios, shared with the CI
+# smoke stage (moolib_tpu.testing.scenarios): process-level ProcFaultPlan
+# faults with the same seed-replay discipline as the wire faults.
+
+
+def test_chaos_envpool_worker_kill_scenario():
+    """SIGKILL 1-of-N env workers mid-batch (the seeded slot): the
+    in-flight batch fails fast and typed (WorkerDied:, retry-safe), the
+    surviving slices are served exactly once across the retry, the slot
+    respawns within the restart budget, post-respawn steps/s recovers to
+    >= 80% of pre-kill, the event log is seed-replay-identical, and
+    verify_telemetry matches the plan — the ISSUE-12 acceptance."""
+    from moolib_tpu.testing.scenarios import scenario_envpool_worker_kill
+
+    summary = scenario_envpool_worker_kill(seed=606)
+    assert summary == {"proc_kill": 1}, summary
+
+
+def test_chaos_envpool_wedge_scenario():
+    """SIGSTOP wedge: the hung-step watchdog distinguishes the wedged
+    worker from a slow one, reaps it within the watchdog deadline, and
+    the batch completes on retry after the respawn."""
+    from moolib_tpu.testing.scenarios import scenario_envpool_wedge
+
+    summary = scenario_envpool_wedge(seed=707)
+    assert summary == {"proc_stop": 1}, summary
+
+
+def test_chaos_envpool_poison_scenario():
+    """Poison env quarantined (terminal row, per-index report, telemetry)
+    while its worker survives and the cohort keeps stepping; nothing is
+    injected, so the event log is empty and trivially seed-identical."""
+    from moolib_tpu.testing.scenarios import scenario_envpool_poison
+
+    summary = scenario_envpool_poison(seed=808)
+    assert summary == {}, summary
+
+
+def test_procfaultplan_seed_replay_determinism():
+    """ISSUE-12 satellite: ProcFaultPlan decisions and event logs are pure
+    in the seed — two plans with the same seed draw the same targets and,
+    driven through the same scripted action sequence (against throwaway
+    sleeper processes), produce byte-identical event logs; a different
+    seed diverges in its draws."""
+    import subprocess
+
+    from moolib_tpu.testing.chaos import ProcChaos, ProcFaultPlan
+
+    class _FakePool:
+        def __init__(self, procs):
+            self._procs = procs
+
+    def run(seed):
+        procs = [subprocess.Popen(["sleep", "30"]) for _ in range(3)]
+        try:
+            plan = ProcFaultPlan(seed)
+            chaos = ProcChaos(plan, _FakePool(procs))
+            picks = [plan.pick(3) for _ in range(4)]
+            chaos.wedge(picks[0])
+            chaos.resume(picks[0])
+            chaos.inject_exception(picks[1])
+            chaos.kill(picks[2])
+            plan.verify_telemetry()  # counters mirror the log exactly
+            return picks, [tuple(e) for e in plan.events]
+        finally:
+            for p in procs:
+                try:
+                    p.kill()
+                except ProcessLookupError:
+                    pass
+                p.wait()
+
+    picks1, log1 = run(31)
+    picks2, log2 = run(31)
+    assert picks1 == picks2
+    assert log1 == log2, (log1, log2)
+    assert [e[1] for e in log1] == [
+        "proc_stop", "proc_cont", "proc_raise", "proc_kill"
+    ]
+    # Different seeds diverge (over enough draws to rule out luck).
+    p31, p32 = ProcFaultPlan(31), ProcFaultPlan(32)
+    assert [p31.pick(1000) for _ in range(8)] != [
+        p32.pick(1000) for _ in range(8)
+    ]
+    with pytest.raises(ValueError):
+        ProcFaultPlan(0).pick(0)

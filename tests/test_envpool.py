@@ -1,11 +1,14 @@
+import os
+import signal
 import threading
+import time
 
 import numpy as np
 import pytest
 
-from moolib_tpu.envpool import EnvPool, EnvStepper
+from moolib_tpu.envpool import EnvPool, EnvStepper, WorkerDied, step_with_retry
 
-from fake_env import BadEnv, DictObsEnv, FakeEnv
+from fake_env import BadEnv, CrashEnv, DictObsEnv, FakeEnv, PoisonEnv, SlowEnv
 
 
 def _mirror_step(envs, states, actions):
@@ -198,3 +201,258 @@ def test_notify_gate_stays_closed_without_callbacks():
         fut.result(timeout=0)
     finally:
         pool.close()
+
+
+# -- supervision (ISSUE 12: survivable env tier) ------------------------------
+
+
+def _retry_step(pool, b, a, deadline_s=30.0):
+    """Drive retries until a step completes (respawn in progress raises
+    WorkerDied fast; the restart budget bounds the phase)."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return pool.step(b, a).result(timeout=30)
+        except WorkerDied:
+            assert time.monotonic() < deadline, "pool never recovered"
+            time.sleep(0.02)
+
+
+def test_worker_kill_typed_error_and_exactly_once_retry():
+    """SIGKILL one worker mid-batch: the in-flight future fails FAST with
+    the typed WorkerDied (naming the worker), the pool respawns the slot,
+    and the same-action retry is exactly-once — surviving slices advance
+    by exactly one step (served from their written results, never
+    re-stepped) while the killed slot's fresh envs start at step 1."""
+    pool = EnvPool(SlowEnv, num_processes=2, batch_size=4, num_batches=2,
+                   restart_backoff=0.05, name="t-kill")
+    try:
+        a = np.zeros(4, np.int64)
+        pre = np.array(
+            pool.step(0, a).result(timeout=30)["episode_step"], copy=True
+        )
+        fut = pool.step(0, a)
+        time.sleep(0.05)  # mid-batch: SlowEnv steps take 0.15s each
+        os.kill(pool._procs[0].pid, signal.SIGKILL)
+        with pytest.raises(WorkerDied) as ei:
+            fut.result(timeout=30)
+        assert ei.value.worker == 0
+        assert str(ei.value).startswith("env worker 0")
+        # The error is cached on the future (PR-8 Future semantics).
+        assert fut.exception(timeout=0) is ei.value
+        out = _retry_step(pool, 0, a)
+        # Surviving worker's slice (envs 2..3): exactly one step applied.
+        assert (out["episode_step"][2:] == pre[2:] + 1).all(), (
+            pre, out["episode_step"],
+        )
+        # Respawned slice: fresh envs on their first step.
+        assert (out["episode_step"][:2] == 1).all()
+        # The OTHER buffer still works (only the awaited batch failed).
+        assert pool.step(1, a).result(timeout=30)["obs"].shape[0] == 4
+    finally:
+        pool.close()
+
+
+def test_step_with_retry_helper_heals_worker_death():
+    pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=1,
+                   restart_backoff=0.05, name="t-helper")
+    try:
+        a = np.zeros(4, np.int64)
+        pool.step(0, a).result(timeout=30)
+        os.kill(pool._procs[1].pid, signal.SIGKILL)
+        out = step_with_retry(pool, 0, a, timeout=30.0)
+        assert out["obs"].shape[0] == 4
+    finally:
+        pool.close()
+
+
+def test_watchdog_reaps_sigstop_wedge():
+    """A SIGSTOP'd worker with a step dispatched is indistinguishable from
+    a dead one to waiters: the hung-step watchdog must reap + respawn it
+    within its deadline, failing the batch typed (kind=wedge counted)."""
+    from moolib_tpu.telemetry import global_telemetry
+
+    pool = EnvPool(SlowEnv, num_processes=2, batch_size=2, num_batches=1,
+                   watchdog_timeout=1.0, restart_backoff=0.05,
+                   name="t-wedge")
+    try:
+        a = np.zeros(2, np.int64)
+        pool.step(0, a).result(timeout=30)
+        os.kill(pool._procs[0].pid, signal.SIGSTOP)
+        t0 = time.monotonic()
+        fut = pool.step(0, a)
+        with pytest.raises(WorkerDied, match="watchdog"):
+            fut.result(timeout=30)
+        assert time.monotonic() - t0 < 1.0 + 3.0  # deadline + slack
+        assert _retry_step(pool, 0, a)["obs"].shape[0] == 2
+        reg = global_telemetry().registry
+        assert reg.value("envpool_worker_deaths_total",
+                         pool="t-wedge", kind="wedge") == 1
+    finally:
+        pool.close()
+
+
+def test_restart_budget_degrades_to_permanent_down():
+    """A crash-looping worker (its envs hard-kill the process on every
+    step) exhausts the restart budget and degrades to a permanently-down
+    slot: its slice is masked with terminal transitions and the pool
+    keeps serving the surviving slices instead of spinning."""
+    pool = EnvPool(CrashEnv, num_processes=2, batch_size=4, num_batches=1,
+                   restart_limit=1, restart_window=60.0,
+                   restart_backoff=0.05, name="t-budget")
+    try:
+        a = np.zeros(4, np.int64)
+        deadline = time.monotonic() + 45
+        while not pool.workers_down():
+            assert time.monotonic() < deadline, "slot never went down"
+            try:
+                pool.step(0, a).result(timeout=30)
+            except WorkerDied:
+                time.sleep(0.05)
+        assert pool.workers_down() == (0,)  # CrashEnv seed 1 lives in slot 0
+        out = _retry_step(pool, 0, a)
+        assert out["done"][:2].all(), out["done"]  # masked slice: terminal
+        assert (out["episode_step"][2:] > 0).all()  # survivors still step
+        assert pool.supervisor_stats()["down"] == (0,)
+    finally:
+        pool.close()
+
+
+def test_poison_env_quarantined_worker_survives():
+    """An env that raises on every step is quarantined inside its worker
+    after poison_threshold consecutive failures — terminal row, reported
+    per index — and the worker NEVER dies (no respawn churn)."""
+    from moolib_tpu.telemetry import global_telemetry
+
+    pool = EnvPool(PoisonEnv, num_processes=2, batch_size=4, num_batches=1,
+                   poison_threshold=2, name="t-poison")
+    try:
+        a = np.ones(4, np.int64)
+        deadline = time.monotonic() + 20
+        while pool.quarantined() != (1,):
+            assert time.monotonic() < deadline, "poison never quarantined"
+            out = pool.step(0, a).result(timeout=30)
+            time.sleep(0.01)
+        out = pool.step(0, a).result(timeout=30)
+        assert bool(out["done"][1]) and out["episode_step"][1] == 0
+        assert out["episode_step"][0] > 0  # healthy envs keep advancing
+        reg = global_telemetry().registry
+        assert reg.value("envpool_quarantined_total", pool="t-poison") == 1
+        assert reg.value("envpool_worker_deaths_total",
+                         pool="t-poison", kind="exit") is None
+    finally:
+        pool.close()
+
+
+def test_pipe_mode_supervision(monkeypatch):
+    """The supervision contract holds on the pipe fallback data plane too
+    (no native semaphores): kill -> typed failure -> respawn -> exactly-
+    once retry."""
+    from moolib_tpu.envpool import pool as pool_mod
+
+    monkeypatch.setattr(pool_mod, "_get_native", lambda: None)
+    pool = EnvPool(SlowEnv, num_processes=2, batch_size=4, num_batches=2,
+                   restart_backoff=0.05, name="t-pipe")
+    try:
+        assert pool._ctrl is None  # really on the pipe plane
+        a = np.zeros(4, np.int64)
+        pre = np.array(
+            pool.step(0, a).result(timeout=30)["episode_step"], copy=True
+        )
+        fut = pool.step(0, a)
+        time.sleep(0.05)
+        os.kill(pool._procs[0].pid, signal.SIGKILL)
+        with pytest.raises(WorkerDied):
+            fut.result(timeout=30)
+        out = _retry_step(pool, 0, a)
+        assert (out["episode_step"][2:] == pre[2:] + 1).all()
+    finally:
+        pool.close()
+
+
+def test_close_bounded_and_idempotent_with_stuck_worker():
+    """ISSUE-12 satellite: close() with a SIGSTOP-stuck worker and a step
+    in flight returns within the close budget (kill escalation reaps
+    stopped processes), is idempotent, and __del__ after close is a
+    no-op. The shm segment is released (no deferred-release leak)."""
+    pool = EnvPool(SlowEnv, num_processes=2, batch_size=2, num_batches=1,
+                   close_timeout=2.0, name="t-close")
+    shm_name = pool._shm.name
+    pool.step(0, np.zeros(2, np.int64)).result(timeout=30)
+    fut = pool.step(0, np.zeros(2, np.int64))
+    os.kill(pool._procs[1].pid, signal.SIGSTOP)
+    t0 = time.monotonic()
+    pool.close()
+    assert time.monotonic() - t0 < 6.0  # bounded, not 5s-per-proc sums
+    # The in-flight future resolves (closed), never hangs.
+    with pytest.raises(RuntimeError, match="closed"):
+        fut.result(timeout=0)
+    t0 = time.monotonic()
+    pool.close()  # idempotent: immediate no-op
+    assert time.monotonic() - t0 < 0.1
+    pool.__del__()  # and safe after close
+    # Segment really unlinked: re-attaching by name must fail.
+    from multiprocessing import shared_memory as mp_shm
+
+    with pytest.raises(FileNotFoundError):
+        mp_shm.SharedMemory(name=shm_name)
+
+
+def test_future_timeout_contract():
+    """EnvStepperFuture.result/exception follow the PR-8 Future contract:
+    negative / non-finite timeouts raise ValueError, timeout=0 is a
+    non-blocking poll."""
+    pool = EnvPool(SlowEnv, num_processes=1, batch_size=1, num_batches=1,
+                   name="t-timeout")
+    try:
+        fut = pool.step(0, np.zeros(1, np.int64))
+        for bad in (-1, -0.5, float("inf"), float("nan")):
+            with pytest.raises(ValueError, match="timeout"):
+                fut.result(bad)
+            with pytest.raises(ValueError, match="timeout"):
+                fut.exception(bad)
+        # timeout=0 polls: the SlowEnv step is still in flight.
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=0)
+        with pytest.raises(TimeoutError):
+            fut.exception(timeout=0)
+        assert time.monotonic() - t0 < 0.25, "timeout=0 must not block"
+        out = fut.result(timeout=30)
+        assert fut.exception(timeout=0) is None
+        assert fut.result(timeout=0) is out  # cached outcome
+    finally:
+        pool.close()
+
+
+def test_abandoned_pool_is_collected_and_workers_reaped():
+    """Review regression: the supervisor thread holds the pool only via a
+    weakref, so a pool dropped WITHOUT close() is still garbage-collected
+    — __del__ runs close() and the worker processes die (no permanent
+    worker/shm leak from an abandoned pool)."""
+    import gc
+    import weakref as _weakref
+
+    pool = EnvPool(FakeEnv, num_processes=1, batch_size=1, num_batches=1,
+                   name="t-gc")
+    if pool._ctrl is None:
+        pool.close()
+        pytest.skip("pipe mode's drain thread pins the pool (pre-existing)")
+    pool.step(0, np.zeros(1, np.int64)).result(timeout=30)
+    pid = pool._procs[0].pid
+    wref = _weakref.ref(pool)
+    del pool
+    deadline = time.monotonic() + 10
+    while wref() is not None and time.monotonic() < deadline:
+        gc.collect()
+        time.sleep(0.05)
+    assert wref() is None, "abandoned pool never collected (leak)"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            break  # worker reaped by __del__ -> close()
+        time.sleep(0.05)
+    else:
+        raise AssertionError("abandoned pool's worker still alive")

@@ -208,13 +208,179 @@ def test_stale_step_rejected_and_lease_reclaim():
         rpc_b, b = _client(addr, "actor-b")  # lease expired: reclaims
         assert b.batch_index == 0
         b.step(np.zeros(4, np.int64)).result(timeout=60)
-        # The stale owner's step is rejected, not silently executed.
+        # The stale owner's step is rejected, not silently executed (the
+        # raw future shows the refusal; the default retrying future would
+        # instead try to re-acquire — pinned in
+        # test_lease_reclaim_then_retry_reacquires).
         with pytest.raises(RpcError, match="not owned"):
-            a.step(np.zeros(4, np.int64)).result(timeout=60)
+            a.step(np.zeros(4, np.int64), retry=False).result(60)
         b.close()
         rpc_a.close()
         rpc_b.close()
     finally:
+        server.close()
+        srv_rpc.close()
+        pool.close()
+
+
+# -- served-step failover (ISSUE 12: survivable env tier) ---------------------
+
+
+def test_worker_died_wire_error_is_typed_and_retry_safe():
+    """A worker death during a served step reaches the client as a
+    'WorkerDied:' wire error — classified worker_died (retry-safe) by the
+    serving tier's error_kind taxonomy — and the default retrying step
+    future absorbs it against the same lease."""
+    import os
+    import signal
+    import time as _time
+
+    from moolib_tpu.serving import error_kind
+    from fake_env import SlowEnv
+
+    pool = EnvPool(SlowEnv, num_processes=2, batch_size=4, num_batches=2,
+                   restart_backoff=0.05, name="t-wire")
+    srv_rpc = Rpc("env-server")
+    srv_rpc.listen("127.0.0.1:0")
+    server = EnvPoolServer(srv_rpc, pool)
+    rpc, st = _client(srv_rpc.debug_info()["listen"][0], "actor-w")
+    try:
+        a = np.zeros(4, np.int64)
+        st.step(a).result(timeout=60)
+        # Raw (non-retrying) future: the typed wire error surfaces.
+        fut = st.step(a, retry=False)
+        _time.sleep(0.05)  # mid-batch
+        os.kill(pool._procs[0].pid, signal.SIGKILL)
+        with pytest.raises(RpcError) as ei:
+            fut.result(60)
+        assert str(ei.value).startswith("WorkerDied:"), str(ei.value)
+        assert error_kind(ei.value) == "worker_died"
+        # The default retrying future heals transparently.
+        out = st.step(a).result(timeout=60)
+        assert out["obs"].shape[0] == 4
+        assert st.retries_total >= 1
+    finally:
+        st.close()
+        rpc.close()
+        server.close()
+        srv_rpc.close()
+        pool.close()
+
+
+def test_lease_reclaim_then_retry_reacquires():
+    """ISSUE-12 satellite: a client whose lease was reclaimed (it stalled
+    past lease_timeout and another client took + released the buffer)
+    gets 'not owned' on its next step — the retrying future re-acquires
+    the reclaimed lease and the step completes."""
+    import time as _time
+
+    pool = EnvPool(FakeEnv, num_processes=2, batch_size=4, num_batches=1)
+    srv_rpc = Rpc("env-server")
+    srv_rpc.listen("127.0.0.1:0")
+    server = EnvPoolServer(srv_rpc, pool, lease_timeout=0.4)
+    addr = srv_rpc.debug_info()["listen"][0]
+    rpc_a, a = _client(addr, "actor-a")
+    try:
+        act = np.zeros(4, np.int64)
+        a.step(act).result(timeout=60)
+        _time.sleep(0.6)  # actor-a stalls past its lease
+        rpc_b, b = _client(addr, "actor-b")  # reclaims buffer 0
+        assert b.batch_index == 0
+        b.step(act).result(timeout=60)
+        b.close()  # frees the buffer again
+        rpc_b.close()
+        # actor-a's raw step is rejected (stale lease)...
+        with pytest.raises(RpcError, match="not owned"):
+            a.step(act, retry=False).result(60)
+        # ...but the retrying future re-acquires and completes.
+        out = a.step(act).result(timeout=60)
+        assert out["obs"].shape[0] == 4
+        assert a.reacquires_total >= 1
+        assert a.batch_index == 0
+    finally:
+        a.close()
+        rpc_a.close()
+        server.close()
+        srv_rpc.close()
+        pool.close()
+
+
+def test_step_future_timeout_contract():
+    """RemoteEnvStepper step futures follow the PR-8 Future contract."""
+    pool = EnvPool(FakeEnv, num_processes=1, batch_size=2, num_batches=1)
+    srv_rpc = Rpc("env-server")
+    srv_rpc.listen("127.0.0.1:0")
+    server = EnvPoolServer(srv_rpc, pool)
+    rpc, st = _client(srv_rpc.debug_info()["listen"][0], "actor-t")
+    try:
+        fut = st.step(np.zeros(2, np.int64))
+        for bad in (-1, float("inf"), float("nan")):
+            with pytest.raises(ValueError, match="timeout"):
+                fut.result(bad)
+            with pytest.raises(ValueError, match="timeout"):
+                fut.exception(bad)
+        assert fut.result(timeout=60)["obs"].shape[0] == 2
+        assert fut.exception(timeout=0) is None
+    finally:
+        st.close()
+        rpc.close()
+        server.close()
+        srv_rpc.close()
+        pool.close()
+
+
+def test_new_owner_after_failed_step_gets_fresh_dispatch():
+    """Review regression: a buffer whose last step FAILED (WorkerDied,
+    repair state pending) and was then released/reclaimed must serve the
+    NEW owner's action — never the old owner's via the repair path. The
+    acquire resets the failed batch (or refuses fast while it settles)."""
+    import os
+    import signal
+    import time as _time
+
+    from fake_env import SlowEnv
+
+    pool = EnvPool(SlowEnv, num_processes=2, batch_size=4, num_batches=1,
+                   restart_backoff=0.05, name="t-newowner")
+    srv_rpc = Rpc("env-server")
+    srv_rpc.listen("127.0.0.1:0")
+    server = EnvPoolServer(srv_rpc, pool)
+    addr = srv_rpc.debug_info()["listen"][0]
+    rpc_a, a = _client(addr, "actor-a")
+    rpc_b = Rpc("actor-b")
+    rpc_b.connect(addr)
+    try:
+        a.step(np.zeros(4, np.int64)).result(timeout=60)
+        fut = a.step(np.zeros(4, np.int64), retry=False)
+        _time.sleep(0.05)  # mid-batch
+        os.kill(pool._procs[0].pid, signal.SIGKILL)
+        with pytest.raises(RpcError, match="WorkerDied"):
+            fut.result(60)
+        a.close()  # releases the failed (repair-pending) buffer
+
+        # B acquires the same buffer (riding out the settling window) and
+        # steps a DIFFERENT action: every row must reflect B's action.
+        deadline = _time.monotonic() + 30
+        while True:
+            try:
+                b = RemoteEnvStepper(rpc_b, "env-server")
+                break
+            except RpcError as e:
+                assert "settling" in str(e), str(e)
+                assert _time.monotonic() < deadline
+                _time.sleep(0.05)
+        out = b.step(np.full(4, 5, np.int64)).result(timeout=60)
+        # FakeEnv reward = seed + t*action; action 5 != old action 0.
+        for i in range(4):
+            assert out["reward"][i] == i + out["episode_step"][i] * 5, (
+                "row served with the OLD owner's action: "
+                f"{i}: reward={out['reward'][i]} "
+                f"t={out['episode_step'][i]}"
+            )
+        b.close()
+    finally:
+        rpc_a.close()
+        rpc_b.close()
         server.close()
         srv_rpc.close()
         pool.close()

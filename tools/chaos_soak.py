@@ -8,11 +8,16 @@ cannot drift) against a live in-process cluster. Two modes:
 - ``--smoke``: one pass over all scenarios (loss storm, partition+heal,
   leader loss, learner SIGKILL+restart, broker kill+standby promotion,
   straggler slow-link quorum commit, serving replica-kill mid-load,
-  serving router-partition), bounded well under 60s, CPU-only — the CI
-  stage wired into tools/ci_check.sh. The serving pair is the ROADMAP
-  item-3 acceptance: a router + in-process replicas on OS-assigned
-  ports, one replica killed mid-load, bounded completion and a
-  served-p99 ceiling asserted.
+  serving router-partition, and the env tier's survivable trio:
+  env-worker SIGKILL mid-batch, SIGSTOP wedge vs the hung-step
+  watchdog, poison-env quarantine), bounded well under 60s, CPU-only —
+  the CI stage wired into tools/ci_check.sh. The serving pair is the
+  ROADMAP item-3 acceptance: a router + in-process replicas on
+  OS-assigned ports, one replica killed mid-load, bounded completion
+  and a served-p99 ceiling asserted. The env trio injects
+  process-level faults (``ProcFaultPlan``: kill/SIGSTOP+SIGCONT/
+  exception-injection by seeded worker slot) under the same
+  seed-replay discipline as the wire faults.
 - ``--seed N --minutes M``: the long-run soak — scenarios loop with
   seeds derived from ``N`` until the time budget is spent, so one
   invocation covers many distinct seeded schedules. Marked slow by

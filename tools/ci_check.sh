@@ -55,19 +55,24 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/perf.py \
   --suite cpu-proxy --smoke --trends bench/trends.jsonl
 
 echo "== chaos + serving smoke =="
-# Bounded seeded fault-injection pass (8 scenarios, well under 60s,
+# Bounded seeded fault-injection pass (11 scenarios, well under 60s,
 # CPU-only): loss storm, partition+heal, leader loss, the survivable-
 # training trio (learner SIGKILL + same-name restart rejoin with loss
 # continuity; broker kill + standby promotion adopting the epoch from
 # gossip with an in-flight op surviving; straggler slow-link quorum
-# commit with exactly-once late re-contribution), plus the serving
+# commit with exactly-once late re-contribution), the serving
 # tier's replica-kill (router + in-process replicas on OS-assigned
 # ports, one killed mid-load: bounded completion, served-p99 ceiling,
 # metric-family consistency) and router-partition (health-gated drain
-# from rotation + return after heal). A failure prints the seed +
-# replay command (long-run version: chaos_soak.py --minutes;
-# --scenario GLOB selects a subset; per-scenario wall time rides the
-# JSON report).
+# from rotation + return after heal), plus the env tier's survivable
+# trio (worker SIGKILL mid-batch: typed retry-safe failure, exactly-
+# once retry, steps/s recovery; SIGSTOP wedge reaped by the hung-step
+# watchdog within its deadline; poison env quarantined while the
+# cohort keeps stepping — process-level ProcFaultPlan faults with the
+# same seed-replay discipline as the wire faults). A failure prints
+# the seed + replay command (long-run version: chaos_soak.py
+# --minutes; --scenario GLOB selects a subset; per-scenario wall time
+# rides the JSON report).
 # --locktrace additionally runs the whole pass under instrumented locks
 # (testing/locktrace.py): the OBSERVED acquires-while-holding graph must
 # stay acyclic (no lock-order inversion ever executed) and inside
