@@ -49,6 +49,10 @@ _EXPORTS = {
     "Telemetry": "moolib_tpu.telemetry",
     "global_telemetry": "moolib_tpu.telemetry",
     "publish_metrics": "moolib_tpu.telemetry",
+    # incident forensics (docs/incidents.md)
+    "FlightRecorder": "moolib_tpu.flightrec",
+    "capture_incident": "moolib_tpu.flightrec",
+    "enable_auto_capture": "moolib_tpu.flightrec",
     # utils
     "set_log_level": "moolib_tpu.utils",
     "set_logging": "moolib_tpu.utils",

@@ -82,6 +82,7 @@ _PAYLOAD_START = {"async_": 2, "sync": 2, "async_callback": 3,
 # (the f-string-hole abstraction), matching any service prefix.
 _BUILTIN_ENDPOINTS = (
     "__telemetry",
+    "__flightrec",
     WILDCARD + ".infer",
     WILDCARD + ".health",
     WILDCARD + ".load",

@@ -830,6 +830,11 @@ class EnvPool:
         from ..telemetry import global_telemetry
 
         self._tel = global_telemetry()
+        # Flight recorder (moolib_tpu/flightrec): worker death/respawn,
+        # permanent-down degradation, and poison-env quarantine are typed
+        # black-box events; restart-budget exhaustion is an incident
+        # auto-capture trigger.
+        self._fr = self._tel.flight
         reg = self._tel.registry
         self._m_steps = reg.counter("envpool_steps_total")
         self._m_step_dur = reg.histogram("envpool_step_seconds")
@@ -1198,6 +1203,9 @@ class EnvPool:
             self._quarantined.add(gi)
         self._m_quarantined.inc()
         self._m_env_errors.inc()
+        if self._fr.on:
+            self._fr.record("env_quarantine", pool=self.name, env=int(gi),
+                            why=str(why)[:200])
         log.error("env %d quarantined as poison: %s", gi, why)
 
     def _drain_loop(self):
@@ -1384,9 +1392,33 @@ class EnvPool:
                 if cbs:
                     fired.extend(cbs)
             self._charge_restart_budget_locked(w, f"{verb}: {detail}")
+            went_down = w in self._down
+            strikes = len(self._death_times[w])
         log.error("env worker %d %s: %s", w, verb, detail)
         self._death_counter(kind).inc()
+        if self._fr.on:
+            self._fr.record("worker_death", pool=self.name, slot=int(w),
+                            kind=kind, reason=str(detail)[:200])
+        if went_down:
+            self._report_budget_exhaustion(w, strikes, f"{verb}: {detail}")
         self._run_callbacks(fired)
+
+    def _report_budget_exhaustion(self, w: int, strikes: int, why: str):
+        """Worker_down flight event + incident capture for a slot that
+        degraded to permanently down — the ONE reporting path for both
+        ways a budget can run out (death, failed respawn). Called
+        OUTSIDE self._lock: capture writes a bundle and dumps every
+        thread's stack."""
+        if self._fr.on:
+            self._fr.record("worker_down", pool=self.name, slot=int(w),
+                            strikes=int(strikes))
+        from ..flightrec.capture import maybe_capture
+
+        maybe_capture(
+            "worker_budget_exhausted",
+            f"env worker {w} of pool {self.name!r} permanently down "
+            f"after {strikes} strikes ({why})",
+        )
 
     def _charge_restart_budget_locked(self, w: int, why: str):
         """One death / failed respawn attempt against slot ``w``'s restart
@@ -1486,6 +1518,12 @@ class EnvPool:
                 self._charge_restart_budget_locked(
                     w, f"respawn failed: {e}"
                 )
+                went_down = w in self._down
+                strikes = len(self._death_times[w])
+            if went_down:
+                self._report_budget_exhaustion(
+                    w, strikes, f"respawn failed: {e}"
+                )
             log.error("env worker %d respawn failed: %s", w, e)
             return
         now = time.monotonic()
@@ -1505,6 +1543,8 @@ class EnvPool:
         except Exception:  # moolint: disable=swallow-cancelled
             pass  # sync fd close of the dead worker's pipe
         self._m_respawns.inc()
+        if self._fr.on:
+            self._fr.record("worker_respawn", pool=self.name, slot=int(w))
         log.warning(
             "env worker %d respawned (envs [%d, %d) re-created; their "
             "episodes restart)", w, w * per, (w + 1) * per,

@@ -1,0 +1,79 @@
+"""flightrec — black-box flight recorder + cross-peer incident bundles.
+
+The survivability stack (chaosnet, quorum rounds, serving failover, env
+supervision) makes the system *survive* faults; this package makes every
+failure it cannot survive — and every survival worth auditing —
+*debuggable after the fact*, without reproduction:
+
+- :mod:`~moolib_tpu.flightrec.events` / :mod:`~moolib_tpu.flightrec.recorder`
+  — an always-on, bounded, lock-cheap ring of typed state-transition
+  events per process, recorded at the seams that already exist (RPC conn
+  lifecycle, Group epochs and broker authority, Accumulator rounds and
+  elections, serving breakers/shedding, EnvPool worker supervision,
+  chaosnet injections). One ring per :class:`~moolib_tpu.telemetry.Telemetry`
+  (``telemetry.flight``), gated by one attribute check.
+- :mod:`~moolib_tpu.flightrec.bundle` / :mod:`~moolib_tpu.flightrec.capture`
+  — on a trigger (scenario failure, round-failure storm, breaker open,
+  worker restart-budget exhaustion, explicit API) the process freezes
+  event ring + span ring + metrics + thread stacks + env fingerprint
+  into a versioned, strictly-validated on-disk bundle.
+- :mod:`~moolib_tpu.flightrec.crawl` / :mod:`~moolib_tpu.flightrec.merge`
+  — every Rpc serves ``__flightrec``; ``tools/incident_report.py``
+  crawls a live (or dying) cohort from one address, pulls every peer's
+  bundle, aligns clocks via min-RTT ping offset estimation, and merges
+  everything into one causally-ordered timeline (JSONL + Chrome trace).
+
+See docs/incidents.md for the event catalogue, trigger taxonomy, bundle
+schema, and the clock-alignment method.
+"""
+
+from .events import KINDS, check_event_fields
+from .recorder import FlightRecorder
+from .bundle import (
+    BUNDLE_SCHEMA,
+    BUNDLE_VERSION,
+    load_bundle,
+    shift_bundle_ts,
+    snapshot_bundle,
+    validate_bundle,
+    write_bundle,
+)
+from .capture import (
+    auto_capture_dir,
+    capture_incident,
+    disable_auto_capture,
+    enable_auto_capture,
+    maybe_capture,
+    recent_captures,
+)
+from .merge import (
+    estimate_offset,
+    merge_bundles,
+    timeline_to_chrome,
+    write_timeline_jsonl,
+)
+from .crawl import crawl_cohort
+
+__all__ = [
+    "KINDS",
+    "check_event_fields",
+    "FlightRecorder",
+    "BUNDLE_SCHEMA",
+    "BUNDLE_VERSION",
+    "snapshot_bundle",
+    "validate_bundle",
+    "write_bundle",
+    "load_bundle",
+    "shift_bundle_ts",
+    "capture_incident",
+    "maybe_capture",
+    "enable_auto_capture",
+    "disable_auto_capture",
+    "auto_capture_dir",
+    "recent_captures",
+    "estimate_offset",
+    "merge_bundles",
+    "timeline_to_chrome",
+    "write_timeline_jsonl",
+    "crawl_cohort",
+]

@@ -373,6 +373,20 @@ class Accumulator:
         # live HERE — get_gradient_stats() is a thin view over them plus
         # the live protocol state the gauge callbacks read.
         reg = rpc.telemetry.registry
+        # Flight recorder (moolib_tpu/flightrec): leader/election and
+        # round commit/reject/write-off transitions land in the peer's
+        # black box. A *storm* of consecutive failed rounds (one failure
+        # is routine under chaos; a run of them is a wedged cohort's
+        # signature) triggers an incident auto-capture.
+        self._flight = rpc.telemetry.flight
+        self._storm_failures = 0  # consecutive failed rounds (any kind)
+        self._storm_threshold = 3
+        # Capture-due marker: 0 = none; otherwise the failure count
+        # SNAPSHOTTED when the threshold was crossed (a later commit
+        # resets _storm_failures, and the forensic record must describe
+        # the storm that fired the trigger, not the state at drain
+        # time). Set under _lock, drained by update() outside it.
+        self._storm_capture_due = 0
         self._m_count_rounds = reg.counter("acc_count_rounds_total")
         self._m_count_round_failures = reg.counter(
             "acc_count_round_failures_total"
@@ -623,6 +637,21 @@ class Accumulator:
             ):
                 self._start_count_round()
         self._maybe_broadcast_state()  # outside the lock: get_state may be slow
+        # Round-failure-storm incident capture, OUTSIDE the lock (capture
+        # writes a bundle and dumps every thread's stack): the due flag
+        # was set under the lock by _note_round_failure_locked.
+        with self._lock:
+            storm_n = self._storm_capture_due
+            self._storm_capture_due = 0
+        if storm_n:
+            from ..flightrec.capture import maybe_capture
+
+            maybe_capture(
+                "round_failure_storm",
+                f"{storm_n} consecutive failed rounds on "
+                f"{self.rpc.get_name()}",
+                telemetry=self.rpc.telemetry,
+            )
 
     # -- epoch / election -----------------------------------------------------
 
@@ -684,6 +713,11 @@ class Accumulator:
                 self._electing = False
                 self._dark_failures = 0
                 self._leader = leader
+                if self._flight.on:
+                    self._flight.record(
+                        "acc_leader", leader=leader, version=int(version),
+                        is_self=leader == self.rpc.get_name(),
+                    )
                 if leader == self.rpc.get_name():
                     self._synced = True
                 elif self._model_version >= version:
@@ -705,6 +739,9 @@ class Accumulator:
             self._electing = False
             return
         self._m_elections.inc()
+        if self._flight.on:
+            self._flight.record("acc_election",
+                                epoch=str(epoch)[:16] if epoch else None)
         fut.add_done_callback(done)
 
     # -- state sync -----------------------------------------------------------
@@ -903,7 +940,7 @@ class Accumulator:
                         self._attempt += 1
                         self._user_has_contributed = False
                 raise
-            except Exception:
+            except Exception as round_exc:
                 # Compact the snapshot to ONE host-numpy bundle before
                 # restoring (off the training thread, outside the lock):
                 # repeated count-round failures re-open wants_gradients
@@ -932,6 +969,9 @@ class Accumulator:
                 self._m_count_round_failures.inc()
                 with self._lock:
                     restore_snapshot_locked()
+                    self._note_round_failure_locked(
+                        "count", seq, str(round_exc)
+                    )
                     if self._epoch == epoch:
                         self._round_inflight = False
                         self._dark_failures += 1  # gates retries if dark
@@ -1021,6 +1061,18 @@ class Accumulator:
             return
         fut.add_done_callback(done)
 
+    def _note_round_failure_locked(self, kind: str, seq: int, error: str):
+        """One failed round (count or gradient) into the black box; a run
+        of ``_storm_threshold`` consecutive failures marks an incident
+        capture as due (performed by ``update()`` outside the lock —
+        capture writes files and dumps stacks, never under ``_lock``)."""
+        if self._flight.on:
+            self._flight.record("acc_round_failure", kind=kind,
+                                seq=int(seq), error=str(error)[:200])
+        self._storm_failures += 1
+        if self._storm_failures == self._storm_threshold:
+            self._storm_capture_due = self._storm_failures
+
     def _repend_locked(self, bundle, bs, ngrads):
         """Return an already-committed contribution to the pending list so
         it re-enters a later count round — the path for contributions a
@@ -1060,6 +1112,11 @@ class Accumulator:
                 # the snapshot re-enters pending, and the round retries
                 # under a fresh attempt key.
                 self._m_quorum_rejected.inc()
+                if self._flight.on:
+                    self._flight.record(
+                        "acc_round_reject", kind="count", seq=int(seq),
+                        participants=len(names), required=int(required),
+                    )
                 restore_snapshot_locked()
                 self._attempt += 1
                 self._user_has_contributed = False
@@ -1067,6 +1124,12 @@ class Accumulator:
             self._dark_failures = 0
             self._seq = seq + 1
             self._m_count_rounds.inc()
+            self._storm_failures = 0  # a committed round ends any storm
+            if self._flight.on:
+                self._flight.record(
+                    "acc_round_commit", kind="count", seq=int(seq),
+                    participants=len(names), members=int(n),
+                )
             # A count round resolved the current wants_gradients poll;
             # peers may contribute again toward the (still unfilled)
             # virtual batch — all-skip cycles must not livelock
@@ -1090,6 +1153,11 @@ class Accumulator:
             if len(names) < n:
                 self._m_partial_count_rounds.inc()
                 self._m_writeoffs.inc(n - len(names))
+                if self._flight.on:
+                    self._flight.record(
+                        "acc_writeoff", kind="count", seq=int(seq),
+                        written_off=n - len(names),
+                    )
             self._cumulative_bs += total_bs
             # eff_vbs and all_templ are identical on every member
             # (they came out of the allreduce), so every member makes
@@ -1211,6 +1279,7 @@ class Accumulator:
             except Exception as e:
                 self._m_rounds_failed.inc()
                 with self._lock:
+                    self._note_round_failure_locked("gradient", gseq, str(e))
                     if self._epoch == epoch:
                         settle_locked(None)
                         self._dark_failures += 1
@@ -1233,6 +1302,12 @@ class Accumulator:
                         # so everyone rejects, discards the partial sum,
                         # and re-pends its own stake for the next round.
                         self._m_quorum_rejected.inc()
+                        if self._flight.on:
+                            self._flight.record(
+                                "acc_round_reject", kind="gradient",
+                                seq=int(gseq), participants=len(q_names),
+                                required=int(required),
+                            )
                         self._repend_locked(bundle, bs_stake, ngrads)
                         settle_locked(None)
                         return
@@ -1241,6 +1316,12 @@ class Accumulator:
                     if len(q_names) < n_start:
                         self._m_partial_grad_rounds.inc()
                         self._m_writeoffs.inc(n_start - len(q_names))
+                        if self._flight.on:
+                            self._flight.record(
+                                "acc_writeoff", kind="gradient",
+                                seq=int(gseq),
+                                written_off=n_start - len(q_names),
+                            )
                     if self.rpc.get_name() not in q_names:
                         # My bundle provably missed the committed sum:
                         # late contribution — it re-enters pending and
@@ -1263,6 +1344,14 @@ class Accumulator:
                 mean = nest.map_structure(
                     lambda x: x / divisor, total_bundle
                 )
+                self._storm_failures = 0  # a committed round ends any storm
+                if self._flight.on:
+                    self._flight.record(
+                        "acc_round_commit", kind="gradient", seq=int(gseq),
+                        participants=(len(q_names) if quorum_mode
+                                      else n_start),
+                        members=int(n_start),
+                    )
                 settle_locked((mean, divisor))
 
         try:
@@ -1294,10 +1383,13 @@ class Accumulator:
                 fut = self.group.all_reduce(
                     f"acc.grads.{gseq}", (bundle, ngrads), op=_grad_merge
                 )
-        except RpcError:
+        except RpcError as e:
             # Mirror the async-failure path so this peer's release cursor
             # doesn't fall permanently behind the cluster's round keys.
+            # (Lock already held here: _start_grad_round runs inside
+            # _commit_count_round_locked's critical section.)
             self._m_rounds_failed.inc()
+            self._note_round_failure_locked("gradient", gseq, str(e))
             settle_locked(None)
             if self._set_state is not None and not self.is_leader():
                 self._synced = False

@@ -200,6 +200,9 @@ class Group:
         # stay as thin views over the same state the gauges read).
         reg = rpc.telemetry.registry
         g = group_name
+        # Flight recorder (moolib_tpu/flightrec): epoch/membership and
+        # broker-authority transitions land in the peer's black box.
+        self._flight = rpc.telemetry.flight
         self._m_rounds = reg.counter("group_rounds_total", group=g)
         self._m_round_dur = reg.histogram("group_round_seconds", group=g)
         self._m_rounds_expired = reg.counter(
@@ -331,6 +334,10 @@ class Group:
             self.group_name, self.broker_name, self.broker_silence(), nxt,
         )
         self._m_failovers.inc()
+        if self._flight.on:
+            self._flight.record("broker_promote", group=self.group_name,
+                                old=self.broker_name, new=nxt,
+                                silence_s=round(self.broker_silence(), 3))
         self.set_broker_name(nxt)
 
     def set_timeout(self, seconds: float):
@@ -449,6 +456,10 @@ class Group:
             self._m_dark_seconds.inc(now - mark)
         if dark_now and not self._broker_dark_logged:
             self._broker_dark_logged = True
+            if self._flight.on:
+                self._flight.record("broker_dark", group=self.group_name,
+                                    broker=self.broker_name,
+                                    silence_s=round(self.broker_silence(), 3))
             log.warning(
                 "group %s: broker %r silent for %.1fs (grace %.1fs) — "
                 "keeping last membership (%d members), rejoining on the "
@@ -488,6 +499,11 @@ class Group:
                             if _is_current(k, old)]:
                     del self._expired_keys[key]
         self._m_resyncs.inc()
+        if self._flight.on:
+            self._flight.record("group_epoch", group=self.group_name,
+                                sync_id=str(sync_id)[:16],
+                                members=list(members),
+                                cancelled=len(cancelled))
         if cancelled:
             self._m_rounds_cancelled.inc(len(cancelled))
             pool = _completion_executor()

@@ -719,6 +719,14 @@ class Rpc:
         self.telemetry = (
             telemetry if telemetry is not None else Telemetry(self._name)
         )
+        # Black-box flight recorder (moolib_tpu/flightrec): typed state
+        # transitions (conn lifecycle, resends, timeouts) recorded at the
+        # seams below behind the recorder's own one-attribute gate. The
+        # skew hook shifts this peer's *reported* flightrec clock — the
+        # clock-alignment test surface (set_flightrec_skew), 0 in
+        # production.
+        self._flight = self.telemetry.flight
+        self._flightrec_skew_us = 0
         reg = self.telemetry.registry
         self._m_bytes_out = reg.counter("rpc_bytes_sent_total")
         self._m_bytes_in = reg.counter("rpc_bytes_received_total")
@@ -765,6 +773,10 @@ class Rpc:
         # Prometheus text; see docs/observability.md for the scrape
         # how-to and tools/telemetry_dump.py for a cohort-wide dump).
         self.define("__telemetry", self._serve_telemetry)
+        # Incident surface: any peer (tools/incident_report.py) can pull
+        # this peer's frozen flight bundle, sample its clock for offset
+        # estimation, or ask it to write a bundle to disk.
+        self.define("__flightrec", self._serve_flightrec)
 
     # -- loop plumbing -------------------------------------------------------
 
@@ -832,6 +844,16 @@ class Rpc:
 
     def uninstall_fault_hooks(self):
         self._faults = None
+
+    def set_flightrec_skew(self, skew_us: int):
+        """TEST HOOK: shift the wall clock this peer reports on its
+        ``__flightrec`` endpoint (the ``op="time"`` sample and every
+        timestamp in the ``op="snapshot"`` wire bundle) by ``skew_us`` —
+        a coherent simulation of a peer whose clock is off, so the
+        clock-alignment pipeline is testable on one host. On-disk
+        ``op="capture"`` bundles keep the true local clock. Production
+        default is 0."""
+        self._flightrec_skew_us = int(skew_us)
 
     def set_transports(self, transports):
         ts = set(transports)
@@ -1084,6 +1106,9 @@ class Rpc:
                   conn.peer_name, conn.is_closing(), why)
         if self.telemetry.on:
             self._m_conn_drops.inc()
+        if self._flight.on:
+            self._flight.record("conn_down",
+                                peer=conn.peer_name or "?", why=why)
         if self._faults is not None:
             # Observation-only: scenario engines log the teardown. Hook
             # errors are swallowed here on purpose — _drop_conn must
@@ -1127,6 +1152,10 @@ class Rpc:
                     continue
                 if self.telemetry.on:
                     self._m_resends.inc()
+                if self._flight.on:
+                    self._flight.record("call_resend",
+                                        peer=out.peer_name or "?",
+                                        endpoint=out.fname)
                 try:
                     await self._route_and_send(out)
                 except (asyncio.CancelledError,
@@ -1180,6 +1209,10 @@ class Rpc:
             if out is not None and not out.future.done():
                 if self.telemetry.on:
                     self._m_resends.inc()
+                if self._flight.on:
+                    self._flight.record("call_resend",
+                                        peer=out.peer_name or "?",
+                                        endpoint=out.fname)
                 self._loop.create_task(self._send_out(out))
         elif fid in (FID_SUCCESS, FID_ERROR, FID_FNF):
             self._on_response(conn, rid, fid, obj)
@@ -1246,6 +1279,9 @@ class Rpc:
                 # newest wins. Or old is already closing.
                 self._drop_conn(old, "replaced by newer connection")
         peer.conns[conn.transport] = conn
+        if self._flight.on:
+            self._flight.record("conn_up", peer=name,
+                                transport=conn.transport)
         if peer.found_event is not None:
             peer.found_event.set()
         # Flush anything waiting on this peer.
@@ -1861,6 +1897,10 @@ class Rpc:
                         self._outgoing.pop(rid, None)
                         if self.telemetry.on:
                             self._m_timeouts.inc()
+                        if self._flight.on:
+                            self._flight.record("call_timeout",
+                                                peer=out.peer_name or "?",
+                                                endpoint=out.fname)
                         out.future._set_exception(
                             RpcError(
                                 f"call to {out.peer_name}::{out.fname} "
@@ -2050,6 +2090,61 @@ class Rpc:
             all_spans.sort(key=lambda s: (s.ts, s.pid, s.name))
             out["trace"] = spans_to_chrome(all_spans)
         return out
+
+    def _serve_flightrec(self, op: str = "snapshot", trigger: str = "api",
+                         detail: str = ""):
+        """Handler for the auto-defined ``__flightrec`` endpoint — the
+        incident surface ``tools/incident_report.py`` crawls.
+
+        - ``op="time"``: ``{"name", "time_us"}`` — a minimal wall-clock
+          sample for NTP-style offset estimation (the caller brackets the
+          call and keeps the min-RTT sample; see
+          :func:`moolib_tpu.flightrec.merge.estimate_offset`).
+        - ``op="snapshot"`` (default): freeze and return this peer's
+          bundle (flight events + spans + metrics + thread stacks +
+          fingerprint, process-global state merged in) without touching
+          disk, plus the dialable-neighbour list so one address crawls
+          the cohort, plus the paths of bundles already captured on
+          disk here.
+        - ``op="capture"``: write an incident bundle to this peer's disk
+          (trigger/detail recorded) and return its path — the
+          "dying cohort: freeze everything NOW" verb.
+
+        The ``set_flightrec_skew`` test hook shifts the *wire-served*
+        clock — the ``op="time"`` sample and the ``op="snapshot"``
+        bundle — so the alignment pipeline is exercisable on one host.
+        On-disk captures (``op="capture"``) are real local evidence and
+        stay in the process's true clock.
+        """
+        from ..flightrec.bundle import shift_bundle_ts, snapshot_bundle
+        from ..flightrec.capture import capture_incident, recent_captures
+        from ..telemetry import now_us
+
+        skew = self._flightrec_skew_us
+        if op == "time":
+            return {"name": self._name, "time_us": now_us() + skew}
+        if op == "capture":
+            path = capture_incident(
+                trigger, detail or "requested via __flightrec",
+                telemetry=self.telemetry,
+            )
+            return {"name": self._name, "path": path}
+        if op != "snapshot":
+            raise RpcError(f"__flightrec: unknown op {op!r}")
+        bundle = snapshot_bundle(
+            self.telemetry, trigger="scrape",
+            detail=detail or "live __flightrec snapshot",
+        )
+        if skew:
+            bundle = shift_bundle_ts(bundle, skew)
+        return {
+            "name": self._name,
+            "time_us": now_us() + skew,
+            "bundle": bundle,
+            "peers": sorted(p.name for p in list(self._peers.values())
+                            if p.addresses and p.name != self._name),
+            "captured": recent_captures(),
+        }
 
     @property
     def name(self):

@@ -205,6 +205,9 @@ class AdmissionQueue:
         now = time.monotonic()
         if self.would_shed(deadline, now):
             self._m_shed.inc()
+            fr = self._tel.flight
+            if fr.on:
+                fr.record("serving_shed", service=self.service, shed=1)
             raise DeadlineExceeded(
                 f"remaining budget {max(0.0, deadline - now):.3f}s cannot "
                 f"cover observed p50 service time "
@@ -273,6 +276,10 @@ class AdmissionQueue:
             # only after the lock releases, so the order is invisible.
             if shed:
                 self._m_shed.inc(len(shed))
+                fr = self._tel.flight
+                if fr.on:  # the recorder lock is a leaf under _cond
+                    fr.record("serving_shed", service=self.service,
+                              shed=len(shed))
             self._cond.notify_all()
             self._inflight += len(serve) + len(shed)
         return serve, shed
@@ -308,6 +315,10 @@ class AdmissionQueue:
         Returns True when the queue fully drained within ``timeout``."""
         with self._cond:
             self._draining = True
+            fr = self._tel.flight
+            if fr.on:  # the recorder lock is a leaf under _cond
+                fr.record("serving_drain", service=self.service,
+                          pending=len(self._entries) + self._inflight)
             self._cond.notify_all()
             self._cond.wait_for(
                 lambda: (not self._entries and self._inflight == 0)

@@ -38,9 +38,16 @@ class CircuitBreaker:
 
     def __init__(self, *, window: int = 16, threshold: float = 0.5,
                  min_samples: int = 4, cooldown_s: float = 0.5,
-                 cooldown_cap_s: float = 8.0, seed: Optional[int] = None):
+                 cooldown_cap_s: float = 8.0, seed: Optional[int] = None,
+                 name: str = "", telemetry=None):
         if not 0.0 < threshold <= 1.0:
             raise ValueError(f"threshold must be in (0, 1], got {threshold!r}")
+        # Flight-recorder identity: open/close transitions are recorded
+        # as typed events (and an open is an incident auto-capture
+        # trigger). ``telemetry`` defaults to the process-global
+        # instance, resolved lazily — a breaker has no peer identity.
+        self._name = name
+        self._tel = telemetry
         self._lock = threading.Lock()
         self._window: "deque[bool]" = deque(maxlen=int(window))
         self._threshold = float(threshold)
@@ -58,7 +65,17 @@ class CircuitBreaker:
     def state(self) -> str:
         return self._state
 
+    def _telemetry(self):
+        tel = self._tel
+        if tel is None:
+            from ..telemetry import global_telemetry
+
+            tel = global_telemetry()
+        return tel
+
     def record(self, ok: bool, now: float) -> None:
+        opened = closed_now = False
+        failures = 0
         with self._lock:
             self._window.append(bool(ok))
             if self._state == "half_open":
@@ -68,16 +85,38 @@ class CircuitBreaker:
                     self._cooldown = self._base_cooldown
                     self._window.clear()
                     self._window.append(True)
+                    closed_now = True
                 else:
                     self._open(now)
+                    opened, failures = True, 1
                 self._trial_pending = False
-                return
-            if self._state == "closed":
+            elif self._state == "closed":
                 n = len(self._window)
                 if n >= self._min_samples:
                     failures = sum(1 for v in self._window if not v)
                     if failures / n >= self._threshold:
                         self._open(now)
+                        opened = True
+        # Flight events + incident capture OUTSIDE the breaker lock:
+        # capture writes a bundle and dumps thread stacks.
+        if opened:
+            fr = self._telemetry().flight
+            if fr.on:
+                fr.record("breaker_open", name=self._name,
+                          failures=int(failures),
+                          window=self._window.maxlen)
+            from ..flightrec.capture import maybe_capture
+
+            maybe_capture(
+                "breaker_open",
+                f"circuit breaker {self._name or '(unnamed)'} opened "
+                f"({failures} failures in window)",
+                telemetry=self._tel,
+            )
+        elif closed_now:
+            fr = self._telemetry().flight
+            if fr.on:
+                fr.record("breaker_close", name=self._name)
 
     def _open(self, now: float) -> None:
         self._state = "open"
@@ -136,7 +175,7 @@ class ReplicaHealth:
         self._misses = 0
         self._ever_ok = False  # routable only after a first good probe
         self.breaker = breaker if breaker is not None \
-            else CircuitBreaker(seed=seed)
+            else CircuitBreaker(seed=seed, name=name)
         self.outstanding = 0  # router-side in-flight (guard with lock)
         self.latency = RollingQuantile(latency_window)
         # Last scraped health-endpoint signals (None until first probe).

@@ -47,9 +47,14 @@ from .registry import (
     quantile_from_export,
 )
 from .trace import Span, TraceBuffer, now_us, spans_to_chrome
+# Imported AFTER .registry/.trace: the flightrec package imports
+# moolib_tpu.telemetry.trace, which is satisfied mid-cycle only because
+# those submodules are already in sys.modules by this line.
+from ..flightrec.recorder import FlightRecorder
 
 __all__ = [
     "Telemetry",
+    "FlightRecorder",
     "Registry",
     "Counter",
     "Gauge",
@@ -84,7 +89,18 @@ class Telemetry:
                  tracing: Optional[bool] = None):
         self.name = name
         self.registry = Registry()
-        self.traces = TraceBuffer()
+        # Span-ring evictions are counted (trace_spans_dropped_total) and
+        # labeled on the Chrome export, so a truncated timeline can never
+        # masquerade as a complete one.
+        self.traces = TraceBuffer(
+            drop_counter=self.registry.counter("trace_spans_dropped_total")
+        )
+        # The black-box flight recorder rides the same ownership model as
+        # the registry/span buffer: one typed state-transition ring per
+        # telemetry identity, its own gate (`flight.on`, default on, env
+        # MOOLIB_TPU_FLIGHTREC=0), frozen into incident bundles by
+        # moolib_tpu.flightrec.capture.
+        self.flight = FlightRecorder(name)
         self.on = (
             _env_flag("MOOLIB_TPU_TELEMETRY", True)
             if enabled is None else bool(enabled)

@@ -76,30 +76,52 @@ class TraceBuffer:
 
     Recording is append-under-lock; owners gate recording on their
     ``Telemetry.tracing`` flag, so an idle buffer costs nothing.
+    Evictions are **counted**: :attr:`dropped` and the
+    ``trace_spans_dropped_total`` counter (``drop_counter``, wired by
+    :class:`~moolib_tpu.telemetry.Telemetry`) record how many spans a
+    full ring discarded, and the count rides the Chrome-trace export
+    metadata — a truncated timeline is labeled, never misleading.
     """
 
-    def __init__(self, capacity: int = 65536):
+    def __init__(self, capacity: int = 65536, drop_counter=None):
         self._lock = threading.Lock()
-        self._spans: deque = deque(maxlen=int(capacity))
+        self._capacity = int(capacity)
+        self._spans: deque = deque(maxlen=self._capacity)
+        self._dropped = 0
+        self._drop_counter = drop_counter  # anything with .inc(), or None
+
+    def _append(self, span: Span) -> None:
+        dc = None
+        with self._lock:
+            if len(self._spans) == self._capacity:
+                self._dropped += 1
+                dc = self._drop_counter
+            self._spans.append(span)
+        if dc is not None:
+            dc.inc()  # the counter has its own lock; keep ours a leaf
 
     def add_span(self, name: str, cat: str, pid: str, ts_us: int,
                  dur_us: int, trace_id: Optional[str] = None,
                  tid: int = 0, args: Optional[Dict[str, Any]] = None) -> None:
         """Record a complete (``ph=X``) span."""
-        span = Span(name, cat, "X", int(ts_us), max(0, int(dur_us)),
-                    pid, tid, trace_id, args)
-        with self._lock:
-            self._spans.append(span)
+        self._append(Span(name, cat, "X", int(ts_us), max(0, int(dur_us)),
+                          pid, tid, trace_id, args))
 
     def add_instant(self, name: str, cat: str, pid: str,
                     ts_us: Optional[int] = None,
                     trace_id: Optional[str] = None,
                     args: Optional[Dict[str, Any]] = None) -> None:
         """Record an instant (``ph=i``) event — chaos injections etc."""
-        span = Span(name, cat, "i", now_us() if ts_us is None else int(ts_us),
-                    0, pid, 0, trace_id, args)
+        self._append(
+            Span(name, cat, "i", now_us() if ts_us is None else int(ts_us),
+                 0, pid, 0, trace_id, args)
+        )
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted by ring overflow since construction/clear."""
         with self._lock:
-            self._spans.append(span)
+            return self._dropped
 
     def spans(self) -> List[Span]:
         with self._lock:
@@ -108,6 +130,7 @@ class TraceBuffer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._dropped = 0
 
     def __len__(self) -> int:
         return len(self._spans)
@@ -116,14 +139,18 @@ class TraceBuffer:
         """Export as a Chrome-trace JSON object (load in Perfetto /
         chrome://tracing). ``pid`` strings (peer names) are mapped to
         stable small ints with ``process_name`` metadata events so every
-        peer renders as its own named process track."""
+        peer renders as its own named process track. Eviction counts ride
+        in ``otherData`` so a truncated export is labeled."""
         spans = sorted(self.spans(), key=lambda s: (s.ts, s.pid, s.name))
-        return spans_to_chrome(spans)
+        return spans_to_chrome(spans, dropped=self.dropped)
 
 
-def spans_to_chrome(spans: List[Span]) -> Dict[str, Any]:
+def spans_to_chrome(spans: List[Span],
+                    dropped: Optional[int] = None) -> Dict[str, Any]:
     """Shared Chrome-trace assembly for one buffer or a cross-peer merge
-    (``tools/telemetry_dump.py`` concatenates peers' span lists first)."""
+    (``tools/telemetry_dump.py`` concatenates peers' span lists first).
+    ``dropped`` (when given) labels the export with the span-ring
+    eviction count in ``otherData`` — a truncated timeline must say so."""
     pid_map: Dict[str, int] = {}
     for s in spans:
         if s.pid not in pid_map:
@@ -139,4 +166,7 @@ def spans_to_chrome(spans: List[Span]) -> Dict[str, Any]:
         for name, pid in sorted(pid_map.items(), key=lambda kv: kv[1])
     ]
     events.extend(s.to_event(pid_map) for s in spans)
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if dropped is not None:
+        trace["otherData"] = {"spans_dropped": int(dropped)}
+    return trace

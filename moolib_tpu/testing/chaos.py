@@ -318,6 +318,14 @@ class FaultPlan:
             Event(self._seq, kind, action, me, peer, endpoint, rid, arg)
         )
         self._seq += 1
+        # Black-box mirror: every injected action is also a typed flight
+        # event, so a merged incident timeline shows the fault right next
+        # to the state transitions it caused (the recorder lock is a leaf
+        # under the plan lock).
+        fr = self._tel.flight
+        if fr.on:
+            fr.record("chaos", kind=kind, action=str(action), peer=peer,
+                      endpoint=endpoint)
         c = self._tel_counters.get(kind)
         if c is None:
             c = self._tel.registry.counter("chaos_injected_total", kind=kind)

@@ -1,0 +1,489 @@
+"""flightrec: black-box recorder, incident bundles, cross-peer merge.
+
+Covers the PR-13 contract (docs/incidents.md): typed-event validation at
+the recorder, strict bundle schema with identical write->load
+round-trips, NTP-style clock-offset estimation against deliberately
+skewed peers, the merged cross-peer timeline (aligned, deduplicated,
+causally ordered), trigger-driven capture (rate limiting, soak-runner
+failure path), the span-ring eviction label, and the acceptance
+scenario: a deliberately-failed seeded chaos run crawled via
+``tools/incident_report.py``'s collect/merge path into one timeline
+carrying injected faults, Group/Accumulator state transitions, and
+cross-peer spans in causal order."""
+
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from moolib_tpu.flightrec import (
+    FlightRecorder,
+    capture_incident,
+    disable_auto_capture,
+    enable_auto_capture,
+    estimate_offset,
+    load_bundle,
+    maybe_capture,
+    merge_bundles,
+    recent_captures,
+    shift_bundle_ts,
+    snapshot_bundle,
+    timeline_to_chrome,
+    validate_bundle,
+    write_bundle,
+)
+from moolib_tpu.rpc import Rpc
+from moolib_tpu.telemetry import Telemetry, TraceBuffer
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, REPO_ROOT / "tools" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- recorder ----------------------------------------------------------------
+
+
+def test_recorder_typed_events_validated():
+    fr = FlightRecorder("t")
+    with pytest.raises(ValueError, match="unknown flightrec event kind"):
+        fr.record("not_a_kind", peer="x")
+    with pytest.raises(ValueError, match="requires exactly fields"):
+        fr.record("conn_up", peer="x")  # missing transport
+    with pytest.raises(ValueError, match="requires exactly fields"):
+        fr.record("conn_up", peer="x", transport="tcp", extra=1)
+    with pytest.raises(ValueError, match="JSON scalar"):
+        fr.record("conn_up", peer={"not": "scalar"}, transport="tcp")
+    fr.record("group_epoch", group="g", sync_id="s",
+              members=("a", "b"), cancelled=0)
+    (ev,) = fr.events()
+    assert ev["kind"] == "group_epoch" and ev["pid"] == "t"
+    assert ev["fields"]["members"] == ["a", "b"]  # tuple coerced: JSON-clean
+
+
+def test_recorder_ring_eviction_counted():
+    fr = FlightRecorder("t", capacity=3)
+    for i in range(5):
+        fr.record("conn_up", 1000 + i, peer=f"p{i}", transport="tcp")
+    assert len(fr) == 3 and fr.dropped == 2
+    evs = fr.events()
+    assert [e["fields"]["peer"] for e in evs] == ["p2", "p3", "p4"]
+    assert [e["seq"] for e in evs] == [2, 3, 4]  # seq survives eviction
+    fr.clear()
+    assert len(fr) == 0 and fr.dropped == 0
+
+
+def test_recorder_disabled_cleanliness():
+    """With the gate off, live traffic (greetings, echo, teardown) leaves
+    the rings EMPTY — disabled means silence, not merely cheapness — and
+    a snapshot bundle is still valid, just eventless."""
+    a, b = Rpc("quiet-a"), Rpc("quiet-b")
+    a.telemetry.flight.set_enabled(False)
+    b.telemetry.flight.set_enabled(False)
+    try:
+        b.define("echo", lambda x: x)
+        b.listen("127.0.0.1:0")
+        a.connect(b.debug_info()["listen"][0])
+        for i in range(5):
+            assert a.sync("quiet-b", "echo", i) == i
+        assert len(a.telemetry.flight) == 0, a.telemetry.flight.events()
+        assert len(b.telemetry.flight) == 0, b.telemetry.flight.events()
+        bundle = snapshot_bundle(a.telemetry, trigger="api",
+                                 include_global=False)
+        validate_bundle(bundle)
+        assert bundle["events"] == []
+    finally:
+        a.close()
+        b.close()
+
+
+# -- bundle schema -----------------------------------------------------------
+
+
+def _sample_bundle():
+    tel = Telemetry("peerx", enabled=True, tracing=True)
+    tel.flight.record("conn_up", 1_000_000, peer="y", transport="tcp")
+    tel.flight.record("broker_dark", 2_000_000, group="g", broker="b",
+                      silence_s=4.5)
+    tel.traces.add_span("call echo", "rpc", "peerx", 1_500_000, 250,
+                        trace_id="tid1", args={"peer": "y"})
+    tel.traces.add_instant("chaos drop", "chaos", "peerx", 1_600_000)
+    tel.registry.counter("some_total").inc(3)
+    return snapshot_bundle(tel, trigger="api", detail="unit",
+                           include_global=False)
+
+
+def test_bundle_write_load_roundtrip_identical(tmp_path):
+    bundle = _sample_bundle()
+    path = write_bundle(bundle, str(tmp_path))
+    loaded = load_bundle(path)
+    assert loaded == bundle  # identical object through disk
+    assert Path(path).name.startswith("incident_peerx_")
+
+
+def test_bundle_strict_rejection(tmp_path):
+    good = _sample_bundle()
+
+    def reject(mutate, match):
+        bad = json.loads(json.dumps(good))
+        mutate(bad)
+        with pytest.raises(ValueError, match=match):
+            validate_bundle(bad)
+
+    reject(lambda b: b.update(surprise=1), "top-level keys")
+    # Non-list events/spans must be the documented ValueError (a
+    # TypeError would escape the tools' per-peer error handling and
+    # crash the whole crawl on one bad bundle).
+    reject(lambda b: b.update(events=None), "must be a list")
+    reject(lambda b: b.update(spans={"not": "a list"}), "must be a list")
+    reject(lambda b: b.pop("stacks"), "top-level keys")
+    reject(lambda b: b.update(version=99), "version")
+    reject(lambda b: b.update(schema="other"), "schema")
+    reject(lambda b: b["events"][0].update(kind="zzz"), "unknown kind")
+    reject(lambda b: b["events"][0]["fields"].update(extra=1),
+           "requires exactly fields")
+    reject(lambda b: b["events"][0].update(ts_us="soon"), "must be ints")
+    reject(lambda b: b["spans"][0].update(ph="Q"), "ph")
+    reject(lambda b: b["spans"][0].pop("trace_id"), "span")
+    reject(lambda b: b.update(trigger={"kind": "api"}), "trigger")
+    reject(lambda b: b.update(metrics={"x": {"s": {"no_type": 1}}}),
+           "registry snapshot")
+    reject(lambda b: b.update(events_dropped=-1), "non-negative")
+    # Corrupt file: loud ValueError, never a half-read bundle.
+    p = tmp_path / "trunc.json"
+    p.write_text(json.dumps(good)[: 40])
+    with pytest.raises(ValueError, match="invalid flightrec bundle"):
+        load_bundle(str(p))
+
+
+# -- clock alignment + merge -------------------------------------------------
+
+
+def test_clock_offset_estimation_recovers_skew():
+    a, b = Rpc("clk-a"), Rpc("clk-b")
+    try:
+        b.listen("127.0.0.1:0")
+        a.connect(b.debug_info()["listen"][0])
+        a.sync("clk-b", "__flightrec", op="time")  # warm the route
+        for skew in (3_000_000, -2_000_000, 0):
+            b.set_flightrec_skew(skew)
+            off, rtt = estimate_offset(a, "clk-b")
+            # Residual error is bounded by half the min-RTT sample; give
+            # a loaded CI host 25ms of slack against multi-second skews.
+            assert abs(off - skew) < 25_000, (skew, off, rtt)
+    finally:
+        a.close()
+        b.close()
+
+
+def _event_bundle(name, stamps):
+    """A minimal bundle for ``name`` with conn_up events at the given
+    (ts_us, peer_field) stamps."""
+    tel = Telemetry(name, enabled=True, tracing=False)
+    for ts, p in stamps:
+        tel.flight.record("conn_up", ts, peer=p, transport="tcp")
+    return snapshot_bundle(tel, trigger="api", include_global=False)
+
+
+def test_merge_aligns_two_skewed_fake_peers():
+    # True order: A@1s, B@2s, A@3s, B@4s — but B's clock runs 5s ahead,
+    # so raw timestamps interleave wrongly (B@7s, B@9s after A's).
+    a = _event_bundle("A", [(1_000_000, "e1"), (3_000_000, "e3")])
+    b = shift_bundle_ts(
+        _event_bundle("B", [(2_000_000, "e2"), (4_000_000, "e4")]),
+        5_000_000,
+    )
+    raw, _ = merge_bundles({"A": a, "B": b})
+    assert [r["fields"]["peer"] for r in raw] == ["e1", "e3", "e2", "e4"]
+    aligned, meta = merge_bundles({"A": a, "B": b},
+                                  offsets={"B": 5_000_000})
+    assert [r["fields"]["peer"] for r in aligned] == ["e1", "e2", "e3", "e4"]
+    assert meta["offsets_us"] == {"A": 0, "B": 5_000_000}
+    assert [r["ts_us"] for r in aligned] == [1_000_000, 2_000_000,
+                                             3_000_000, 4_000_000]
+
+
+def test_merge_causal_repair_clamps_handler_before_caller():
+    ta = Telemetry("A", enabled=True, tracing=True)
+    ta.traces.add_span("call f", "rpc", "A", 2_000_000, 500,
+                       trace_id="t1")
+    tb = Telemetry("B", enabled=True, tracing=True)
+    # Residual skew makes the handler land 1ms BEFORE its caller.
+    tb.traces.add_span("handle f", "rpc", "B", 1_999_000, 200,
+                       trace_id="t1")
+    bundles = {
+        "A": snapshot_bundle(ta, include_global=False),
+        "B": snapshot_bundle(tb, include_global=False),
+    }
+    timeline, meta = merge_bundles(bundles)
+    assert meta["causal_adjustments"] == 1
+    call = next(r for r in timeline if r["name"] == "call f")
+    handle = next(r for r in timeline if r["name"] == "handle f")
+    assert handle["ts_us"] == call["ts_us"] + 1
+    assert handle.get("causal_adjusted") is True
+    trace = timeline_to_chrome(timeline, meta)
+    assert trace["otherData"]["causal_adjustments"] == 1
+    names = [e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M"]
+    assert "A/A" not in names and "A" in names and "B" in names
+
+
+def test_merge_dedupes_shared_global_track():
+    """Two same-process peers both pull the process-global track; the
+    merge must keep ONE copy of each shared record."""
+    from moolib_tpu.telemetry import global_telemetry
+
+    gt = global_telemetry()
+    marker = f"dedup-{time.monotonic_ns()}"
+    gt.flight.record("incident", trigger="api", detail=marker)
+    ta, tb = Telemetry("pA"), Telemetry("pB")
+    bundles = {
+        "pA": snapshot_bundle(ta, include_global=True),
+        "pB": snapshot_bundle(tb, include_global=True),
+    }
+    timeline, meta = merge_bundles(bundles)
+    hits = [r for r in timeline if r["type"] == "event"
+            and r["kind"] == "incident"
+            and r["fields"]["detail"] == marker]
+    assert len(hits) == 1, hits
+    assert meta["deduplicated"] >= 1
+
+
+# -- capture + triggers ------------------------------------------------------
+
+
+def test_capture_incident_and_rate_limited_auto(tmp_path):
+    tel = Telemetry("cap")
+    path = capture_incident("api", "unit test", telemetry=tel,
+                            out_dir=str(tmp_path))
+    b = load_bundle(path)
+    assert b["trigger"] == {"kind": "api", "detail": "unit test"}
+    # The trigger itself is on the recorded timeline.
+    assert any(e["kind"] == "incident" for e in b["events"])
+    assert any(r["path"] == path for r in recent_captures())
+    snap = tel.registry.snapshot()
+    assert snap['flightrec_incidents_total{trigger="api"}']["value"] == 1.0
+    # maybe_capture: no-op until a destination is configured...
+    disable_auto_capture()
+    assert maybe_capture("breaker_open", "t", telemetry=tel) is None
+    try:
+        enable_auto_capture(str(tmp_path / "auto"))
+        p1 = maybe_capture("breaker_open", "t", telemetry=tel)
+        assert p1 is not None and load_bundle(p1)
+        # ...and rate-limited per trigger kind once it is.
+        assert maybe_capture("breaker_open", "t", telemetry=tel) is None
+        p2 = maybe_capture("round_failure_storm", "t", telemetry=tel)
+        assert p2 is not None  # distinct trigger: its own limiter
+    finally:
+        disable_auto_capture()
+
+
+def test_chaos_soak_failure_captures_bundle(tmp_path, monkeypatch, capsys):
+    """A failing scenario leaves an incident bundle: path printed next to
+    the replay command and recorded in the JSON report."""
+    soak = _load_tool("chaos_soak")
+
+    def zz_fail(seed):
+        raise AssertionError(f"deliberate failure (seed={seed})")
+
+    monkeypatch.setitem(soak.SCENARIOS, "zz_fail", zz_fail)
+    try:
+        rc = soak.main(["--smoke", "--scenario", "zz_fail",
+                        "--incident-dir", str(tmp_path / "inc")])
+    finally:
+        disable_auto_capture()  # main() enabled auto-capture globally
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "replay: python tools/chaos_soak.py" in out
+    assert "incident bundle:" in out
+    report = json.loads(out.strip().splitlines()[-1])
+    (failure,) = report["failed"]
+    assert failure["scenario"] == "zz_fail"
+    bundle = load_bundle(failure["bundle"])
+    assert bundle["trigger"]["kind"] == "scenario_failure"
+    assert "zz_fail" in bundle["trigger"]["detail"]
+
+
+def test_telemetry_dump_bundle_mode(tmp_path):
+    """--bundle emits the crawl in the incident-bundle format: one
+    validated bundle per crawled peer (one tool family, one schema)."""
+    dump = _load_tool("telemetry_dump")
+    a, b = Rpc("dmp-a"), Rpc("dmp-b")
+    try:
+        b.define("work", lambda x: x)
+        a.listen("127.0.0.1:0")
+        b.listen("127.0.0.1:0")
+        a.connect(b.debug_info()["listen"][0])
+        for i in range(3):
+            assert a.sync("dmp-b", "work", i) == i
+        out = tmp_path / "dump"
+        rc = dump.main(["--connect", a.debug_info()["listen"][0],
+                        "--bundle", "--out", str(out)])
+        assert rc == 0
+        bundles = {
+            load_bundle(str(p))["peer"]
+            for p in (out / "bundles").glob("*.json")
+        }
+        assert bundles == {"dmp-a", "dmp-b"}
+        metrics = json.loads((out / "metrics.json").read_text())
+        assert set(metrics) == {"dmp-a", "dmp-b"}
+    finally:
+        a.close()
+        b.close()
+
+
+# -- span-ring eviction label ------------------------------------------------
+
+
+def test_trace_spans_dropped_counter_and_export_label():
+    tel = Telemetry("drops")
+    counter = tel.registry.counter("trace_spans_dropped_total")
+    buf = TraceBuffer(capacity=3, drop_counter=counter)
+    for i in range(5):
+        buf.add_instant(f"s{i}", "t", "p", ts_us=i)
+    assert buf.dropped == 2
+    assert counter.value == 2.0
+    trace = buf.chrome_trace()
+    assert trace["otherData"] == {"spans_dropped": 2}
+    # The Telemetry-owned buffer is wired to the same counter name.
+    tel2 = Telemetry("wired")
+    assert "trace_spans_dropped_total" in tel2.registry.snapshot()
+    merged = _load_tool("telemetry_dump").merge_chrome_traces(
+        [("p1", trace), ("p2", {"traceEvents": [],
+                                "otherData": {"spans_dropped": 7}})]
+    )
+    assert merged["otherData"]["spans_dropped"] == {"p1": 2, "p2": 7}
+
+
+# -- acceptance: failed chaos run -> one merged timeline ---------------------
+
+
+def test_acceptance_failed_chaos_merged_timeline(tmp_path):
+    """The ISSUE-13 acceptance: a deliberately-failed seeded chaos
+    scenario on a live mini-cohort (two skewed-clock members + broker),
+    crawled through tools/incident_report.py's collect/merge path from
+    ONE address, yields a single merged timeline in which the plan's
+    injected fault events, the typed Group/Accumulator state transitions
+    on every member, and caller->handler spans appear clock-aligned and
+    causally ordered."""
+    from moolib_tpu.parallel import Accumulator
+    from moolib_tpu.testing.chaos import ChaosNet, FaultPlan
+    from moolib_tpu.testing.scenarios import MiniCluster, _pump_accs
+
+    ir = _load_tool("incident_report")
+    cluster = MiniCluster()
+    plan = FaultPlan(seed=11)
+    skews = {"m0": 3_000_000, "m1": -2_000_000}
+    try:
+        accs = []
+        for name, skew in skews.items():
+            rpc, g = cluster.spawn(name)
+            rpc.telemetry.set_tracing(True)
+            rpc.set_flightrec_skew(skew)
+            accs.append(Accumulator(rpc, group=g, virtual_batch_size=2))
+        net = ChaosNet(plan, [a.rpc for a in accs])
+        _pump_accs(accs, lambda: all(
+            a.connected() and a.wants_gradients() for a in accs
+        ), 30, "initial sync")
+        # One clean gradient round: cross-peer reduce/share spans + a
+        # typed commit on both members.
+        for a in accs:
+            a.reduce_gradients({"w": np.ones(2)}, batch_size=1)
+        _pump_accs(accs, lambda: all(a.has_gradients() for a in accs),
+                   30, "clean round")
+        for a in accs:
+            a.result_gradients()
+        # Deliberate failure: partition the members; the in-flight round
+        # can only expire (group timeout), recorded as typed failures.
+        net.partition("m0", "m1")
+        for a in accs:
+            a.reduce_gradients({"w": np.ones(2)}, batch_size=1)
+
+        def saw_failure(a):
+            return any(e["kind"] == "acc_round_failure"
+                       for e in a.rpc.telemetry.flight.events())
+
+        _pump_accs(accs, lambda: all(saw_failure(a) for a in accs),
+                   40, "typed round failure on every member")
+
+        # Crawl the cohort like a production incident: one address.
+        scraper = Rpc("acc-scraper",
+                      telemetry=Telemetry("scr", enabled=False))
+        scraper.set_timeout(10.0)
+        try:
+            bundles, offsets, rtts, captured, failed = ir.collect_live(
+                scraper, [cluster.addr], want=None,
+                discover_seconds=5.0, capture=False,
+            )
+        finally:
+            scraper.close()
+        assert not failed, failed
+        assert {"m0", "m1"} <= set(bundles), sorted(bundles)
+        for name, skew in skews.items():
+            assert abs(offsets[name] - skew) < 25_000, (
+                f"{name}: offset {offsets[name]} vs skew {skew}"
+            )
+        report = ir.write_report(str(tmp_path / "rep"), bundles, offsets,
+                                 rtts, captured, failed)
+        assert report["records"] > 0
+        with open(tmp_path / "rep" / "timeline.jsonl") as f:
+            timeline = [json.loads(line) for line in f]
+    finally:
+        try:
+            net.detach_all()
+        except NameError:
+            pass
+        cluster.close()
+
+    # (1) The injected faults are ON the timeline.
+    injected = [r for r in timeline if r["type"] == "event"
+                and r["kind"] == "chaos"]
+    assert any(r["fields"]["kind"] == "partitioned" for r in injected), (
+        "partition injections missing from the merged timeline"
+    )
+    # (2) Typed Group/Accumulator transitions from EVERY member.
+    for name in ("m0", "m1"):
+        kinds = {r["kind"] for r in timeline
+                 if r["type"] == "event" and r["src"] == name}
+        assert "group_epoch" in kinds, (name, sorted(kinds))
+        assert "acc_leader" in kinds, (name, sorted(kinds))
+        assert "acc_round_commit" in kinds, (name, sorted(kinds))
+        assert "acc_round_failure" in kinds, (name, sorted(kinds))
+    # (3) Cross-peer caller->handler spans, causally ordered and
+    # clock-aligned: the members' clocks disagree by 5s, so unaligned
+    # pairs would be seconds apart (or inverted) — aligned ones must sit
+    # within normal loopback RPC latency.
+    calls = {r["trace_id"]: r for r in timeline
+             if r["type"] == "span" and r["name"].startswith("call ")}
+    pairs = [
+        (calls[r["trace_id"]], r) for r in timeline
+        if r["type"] == "span" and r["name"].startswith("handle ")
+        and r["trace_id"] in calls
+        and calls[r["trace_id"]]["peer"] != r["peer"]
+    ]
+    assert pairs, "no cross-peer call/handle span pairs on the timeline"
+    for call, handle in pairs:
+        assert handle["ts_us"] >= call["ts_us"], (call, handle)
+        assert handle["ts_us"] - call["ts_us"] < 1_000_000, (
+            "span pair not clock-aligned", call, handle,
+        )
+    # (4) The merged timeline is one time-ordered sequence.
+    stamps = [r["ts_us"] for r in timeline]
+    assert stamps == sorted(stamps)
+    # (5) Every written per-peer bundle re-validates from disk.
+    for path in report["bundles"].values():
+        load_bundle(path)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -27,9 +27,16 @@ cannot drift) against a live in-process cluster. Two modes:
 
 Every scenario reports the plan's injected-event summary; a failure
 prints the seed that produced it and a ready replay command, which is
-all that is needed to reproduce (see docs/reliability.md). The JSON
-report aggregates per-scenario wall time (``scenario_seconds``) so a
-scenario creeping toward the smoke budget is visible in CI artifacts.
+all that is needed to reproduce (see docs/reliability.md) — plus the
+path of the incident bundle captured at the moment of failure (the
+flight-recorder ring, spans, metrics, thread stacks and fingerprint of
+the failing run; docs/incidents.md), so a one-in-a-thousand soak
+failure leaves evidence even when the replay does not reproduce it.
+The runner enables flightrec auto-capture for its whole pass
+(``--incident-dir``), so in-stack triggers (breaker open, round-failure
+storm, worker budget exhaustion) also capture while scenarios run. The
+JSON report aggregates per-scenario wall time (``scenario_seconds``)
+and records bundle paths per failed scenario.
 
 Usage::
 
@@ -50,6 +57,10 @@ from fnmatch import fnmatchcase
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from moolib_tpu.flightrec import (  # noqa: E402
+    capture_incident,
+    enable_auto_capture,
+)
 from moolib_tpu.rpc import RpcError  # noqa: E402
 from moolib_tpu.testing.scenarios import SCENARIOS  # noqa: E402
 
@@ -72,6 +83,11 @@ def main(argv=None):
                         help="restrict to scenarios matching this fnmatch "
                              "glob (e.g. 'broker_*'; an exact name works "
                              f"too); known: {', '.join(sorted(SCENARIOS))}")
+    parser.add_argument("--incident-dir", default="incidents",
+                        help="where incident bundles are written: the "
+                             "scenario-failure capture, plus any in-stack "
+                             "auto-capture trigger that fires during the "
+                             "pass (docs/incidents.md)")
     parser.add_argument("--locktrace", action="store_true",
                         help="run under instrumented locks "
                              "(moolib_tpu.testing.locktrace): record the "
@@ -79,6 +95,11 @@ def main(argv=None):
                              "assert it is acyclic AND inside racelint's "
                              "static over-approximation")
     args = parser.parse_args(argv)
+
+    # Black-box auto-capture for the whole pass: a breaker opening or a
+    # worker exhausting its restart budget mid-scenario freezes a bundle
+    # even when the scenario itself goes on to pass.
+    enable_auto_capture(args.incident_dir)
 
     trace = None
     if args.locktrace:
@@ -128,6 +149,26 @@ def main(argv=None):
                       f"{type(e).__name__}: {e}")
                 print(f"  replay: python tools/chaos_soak.py "
                       f"--scenario {name} --seed {seed} --smoke")
+                # Freeze the black box at the moment of failure: the
+                # bundle (event ring, spans, metrics, thread stacks)
+                # is the evidence when the seeded replay does NOT
+                # reproduce (live interleavings differ — see the
+                # determinism contract in testing/chaos.py).
+                try:
+                    bundle_path = capture_incident(
+                        "scenario_failure",
+                        f"{name} seed={seed}: {type(e).__name__}: {e}",
+                        out_dir=args.incident_dir,
+                    )
+                except Exception as ce:  # moolint: disable=swallow-cancelled
+                    # Sync CLI context (no task to cancel): a failed
+                    # capture must not mask the scenario failure.
+                    print(f"  (incident capture failed: {ce})")
+                else:
+                    runs[-1]["bundle"] = bundle_path
+                    print(f"  incident bundle: {bundle_path}  "
+                          f"(merge: python tools/incident_report.py "
+                          f"--bundles {args.incident_dir})")
             if deadline is not None and time.monotonic() > deadline:
                 break
         iteration += 1

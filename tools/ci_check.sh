@@ -79,6 +79,20 @@ echo "== chaos + serving smoke =="
 # racelint's static over-approximation (docs/analysis.md).
 env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke --locktrace
 
+echo "== incident smoke =="
+# flightrec end-to-end (docs/incidents.md): an in-process cohort under a
+# seeded FaultPlan is deliberately driven through faults, then crawled
+# over a real --connect like a production incident — every pulled bundle
+# must pass the strict schema validator and the merged cross-peer
+# timeline must be non-empty, time-ordered, and causally consistent
+# (injected chaos events + conn lifecycle present, call/handle span
+# pairs ordered). The recorder's disabled-mode overhead budget rides the
+# telemetry_smoke stage above (flight gates share the <5% echo budget).
+# chaos_soak above already exercises the failure-path capture: any
+# scenario failure writes a bundle into incidents/ and prints its path
+# next to the seed-replay command (upload incidents/ as a CI artifact).
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/incident_report.py --smoke
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 rc=0

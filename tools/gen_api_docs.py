@@ -44,6 +44,20 @@ MODULES = [
      "histograms; JSON/Prometheus exports"),
     ("moolib_tpu.telemetry.trace", "bounded span buffer with "
      "Chrome-trace/Perfetto export"),
+    ("moolib_tpu.flightrec", "black-box flight recorder + cross-peer "
+     "incident bundles for post-mortem debugging"),
+    ("moolib_tpu.flightrec.events", "typed flight-event schema (kinds + "
+     "field contracts)"),
+    ("moolib_tpu.flightrec.recorder", "bounded ring of typed, "
+     "timestamped state-transition events"),
+    ("moolib_tpu.flightrec.bundle", "versioned on-disk incident bundles "
+     "with strict schema validation"),
+    ("moolib_tpu.flightrec.capture", "incident triggers, rate-limited "
+     "auto-capture, bundle freezing"),
+    ("moolib_tpu.flightrec.merge", "clock-offset estimation + "
+     "causally-ordered cross-peer timeline merge"),
+    ("moolib_tpu.flightrec.crawl", "the one cohort-crawl implementation "
+     "shared by the dump/report tools"),
     ("moolib_tpu.testing.chaos", "chaosnet: deterministic seeded fault "
      "injection (FaultPlan engine + ChaosNet installer)"),
     ("moolib_tpu.testing.scenarios", "canonical chaos scenarios shared by "
@@ -178,7 +192,9 @@ def _index() -> str:
         "[analysis.md](analysis.md). Fault model, delivery guarantees, "
         "and seed replay: [reliability.md](reliability.md). Metric name "
         "catalogue, span semantics, and the scrape how-to: "
-        "[observability.md](observability.md). Benchmark harness "
+        "[observability.md](observability.md). Black-box flight "
+        "recorder, incident bundles, clock-aligned cross-peer "
+        "post-mortems: [incidents.md](incidents.md). Benchmark harness "
         "protocol, CPU-proxy suite, perf budgets, and the "
         "trend/regression gate: [perf.md](perf.md). Serving-tier "
         "architecture, failure model, deadline/shedding semantics, and "
@@ -201,7 +217,11 @@ def _index() -> str:
         "- `tools/serving_load.py` — serving-tier load generator "
         "(throughput/latency report, optional mid-run replica kill).",
         "- `tools/telemetry_dump.py` — scrape a live cohort's "
-        "`__telemetry` endpoints into one merged metrics/trace dump.",
+        "`__telemetry` endpoints into one merged metrics/trace dump "
+        "(`--bundle`: incident-bundle format).",
+        "- `tools/incident_report.py` — crawl `__flightrec` across a "
+        "live cohort into one clock-aligned incident timeline "
+        "(`--smoke` CI stage, `--bundles` offline merge).",
         "- `tools/telemetry_smoke.py` — live scrape validation + "
         "disabled-mode overhead budget (CI stage).",
         "- `python -m moolib_tpu.broker` — standalone membership broker.",

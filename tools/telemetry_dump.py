@@ -17,19 +17,28 @@ every peer it can see, and writes
   injection instants, and jax-profiler capture windows. Peers in one OS
   process each merge the process-global buffer into their export;
   identical events are deduplicated here so shared tracks appear once.
+  Per-peer span-ring eviction counts are carried through into the merged
+  export's ``otherData`` so a truncated timeline is labeled;
+- ``bundles/incident_<peer>_<ts>.json`` — with ``--bundle``, each
+  peer's ``__flightrec`` snapshot written in the incident-bundle format
+  (the SAME versioned, strictly-validated schema
+  ``tools/incident_report.py`` pulls and merges — one tool family, one
+  schema; see docs/incidents.md).
 
 Peers are discovered by crawling: every ``__telemetry`` reply advertises
 the serving peer's dialable neighbours, so dialing into ONE cohort
 member reaches the whole connected cohort (name resolution rides the
 RPC plane's find-peer gossip — connect-only peers without a listen
-address are not reachable and are not advertised). ``--peers`` pins the
-exact set to scrape instead.
+address are not reachable and are not advertised). The crawl itself is
+:func:`moolib_tpu.flightrec.crawl_cohort` — the one implementation this
+tool shares with ``incident_report.py``. ``--peers`` pins the exact set
+to scrape instead.
 
 Usage::
 
     python tools/telemetry_dump.py --connect 127.0.0.1:4411 --out dump/
     python tools/telemetry_dump.py --connect host:4411 --peers a,b \
-        --spans --prometheus --out dump/
+        --spans --prometheus --bundle --out dump/
 """
 
 from __future__ import annotations
@@ -40,13 +49,17 @@ import json
 import os
 import re
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from moolib_tpu.rpc import Rpc, RpcError  # noqa: E402
+from moolib_tpu.rpc import Rpc  # noqa: E402
 from moolib_tpu.telemetry import Telemetry, parse_prometheus  # noqa: E402
+from moolib_tpu.flightrec import (  # noqa: E402
+    crawl_cohort,
+    validate_bundle,
+    write_bundle,
+)
 
 
 def merge_chrome_traces(traces: "list[tuple[str, dict]]") -> dict:
@@ -56,11 +69,17 @@ def merge_chrome_traces(traces: "list[tuple[str, dict]]") -> dict:
     metadata so the same logical track scraped via two peers in one OS
     process lands on one merged track; non-metadata events are
     deduplicated exactly (two peers exporting the shared process-global
-    buffer must not double every chaos instant)."""
+    buffer must not double every chaos instant). Per-peer span-ring
+    eviction counts (``otherData.spans_dropped``) are aggregated so the
+    merged export still labels truncation."""
     track_ids: "dict[str, int]" = {}
     events: "list[dict]" = []
     seen: "set[str]" = set()
-    for _peer, trace in traces:
+    dropped: "dict[str, int]" = {}
+    for peer, trace in traces:
+        other = trace.get("otherData") or {}
+        if "spans_dropped" in other:
+            dropped[peer] = int(other["spans_dropped"])
         names = {
             ev["pid"]: ev["args"]["name"]
             for ev in trace.get("traceEvents", [])
@@ -84,18 +103,25 @@ def merge_chrome_traces(traces: "list[tuple[str, dict]]") -> dict:
                 continue
             seen.add(key)
             events.append(out)
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"spans_dropped": dropped}}
 
 
-def scrape(rpc: Rpc, peer: str, spans: bool, prometheus: bool):
-    """One peer's full scrape: (json snapshot, prom text or None). The
-    per-scrape deadline is the scraper Rpc's call timeout (set_timeout)."""
+def scrape(rpc: Rpc, peer: str, spans: bool, prometheus: bool,
+           bundle: bool):
+    """One peer's full scrape: (json snapshot, prom text or None, bundle
+    or None). The per-scrape deadline is the scraper Rpc's call timeout
+    (set_timeout)."""
     snap = rpc.sync(peer, "__telemetry", spans=spans)
     prom = None
     if prometheus:
         prom = rpc.sync(peer, "__telemetry", fmt="prometheus")
         parse_prometheus(prom)  # format regression -> loud failure
-    return snap, prom
+    bun = None
+    if bundle:
+        reply = rpc.sync(peer, "__flightrec", op="snapshot")
+        bun = validate_bundle(reply["bundle"])
+    return snap, prom, bun
 
 
 def main(argv=None):
@@ -111,6 +137,10 @@ def main(argv=None):
                         help="also scrape trace spans -> trace.json")
     parser.add_argument("--prometheus", action="store_true",
                         help="also write per-peer .prom text expositions")
+    parser.add_argument("--bundle", action="store_true",
+                        help="also pull each peer's __flightrec snapshot "
+                             "and write it in the incident-bundle format "
+                             "(bundles/incident_<peer>_<ts>.json)")
     parser.add_argument("--timeout", type=float, default=10.0,
                         help="per-scrape RPC timeout (s)")
     parser.add_argument("--discover-seconds", type=float, default=2.0,
@@ -126,47 +156,18 @@ def main(argv=None):
     rpc = Rpc("telemetry-dump", telemetry=Telemetry("dump", enabled=False))
     rpc.set_timeout(args.timeout)
     try:
-        for addr in args.connect:
-            rpc.connect(addr)
-        want = (set(args.peers.split(",")) if args.peers else None)
-        # Seed the crawl with the directly-dialed peers (the connection
-        # table never grows spontaneously — gossip is on demand), or with
-        # the pinned --peers set (resolved by name via find-peer gossip).
-        deadline = time.monotonic() + args.discover_seconds
-        seeds: "set[str]" = set()
-        while True:
-            seeds = set(rpc.debug_info()["peers"])
-            if seeds or time.monotonic() > deadline:
-                break
-            time.sleep(0.05)
-        if want is not None:
-            seeds = set(want)
-        if not seeds:
-            print("error: no peers discovered via "
-                  f"{args.connect}", file=sys.stderr)
-            return 1
-
+        want = set(args.peers.split(",")) if args.peers else None
         os.makedirs(args.out, exist_ok=True)
-        metrics: "dict[str, dict]" = {}
-        traces: "list[tuple[str, dict]]" = []
-        failed: "list[str]" = []
         prom_files: "set[str]" = set()
-        queue = sorted(seeds)
-        visited = set(queue)
-        while queue:
-            peer = queue.pop(0)
-            try:
-                snap, prom = scrape(rpc, peer, args.spans, args.prometheus)
-            except (RpcError, TimeoutError, ValueError) as e:
-                # Keep scraping the rest of the cohort; a dark peer is a
-                # finding, not a reason to lose everyone else's data.
-                print(f"FAIL {peer}: {type(e).__name__}: {e}",
-                      file=sys.stderr)
-                failed.append(peer)
-                continue
-            metrics[peer] = snap["metrics"]
-            if args.spans and "trace" in snap:
-                traces.append((peer, snap["trace"]))
+
+        def scrape_one(peer):
+            result = scrape(rpc, peer, args.spans, args.prometheus,
+                            args.bundle)
+            snap = result[0]
+            return result, snap.get("peers", [])
+
+        def progress(peer, result):
+            snap, prom, bun = result
             if prom is not None:
                 # Peer names come off the wire (crawled from remote
                 # replies) — never let one name a path outside --out, and
@@ -182,26 +183,46 @@ def main(argv=None):
                     f.write(prom)
             print(f"ok   {peer}: {len(snap['metrics'])} series"
                   + (f", {sum(1 for e in snap['trace']['traceEvents'] if e.get('ph') != 'M')} spans"
-                     if args.spans and "trace" in snap else ""))
-            if want is None:
-                # Crawl: the reply advertises the peer's dialable
-                # neighbours; walk the whole connected cohort.
-                me = rpc.get_name()
-                for nxt in snap.get("peers", []):
-                    if nxt != me and nxt not in visited:
-                        visited.add(nxt)
-                        queue.append(nxt)
+                     if args.spans and "trace" in snap else "")
+                  + (f", bundle ({len(bun['events'])} events)"
+                     if bun is not None else ""))
 
+        results, failed = crawl_cohort(
+            rpc, args.connect, scrape_one, want=want,
+            discover_seconds=args.discover_seconds, on_result=progress,
+        )
+        for peer, err in failed:
+            # A dark peer is a finding, not a reason to lose everyone
+            # else's data — the crawl already continued past it.
+            print(f"FAIL {peer}: {err}", file=sys.stderr)
+        if not results and not failed:
+            print(f"error: no peers discovered via {args.connect}",
+                  file=sys.stderr)
+            return 1
+
+        metrics = {peer: snap["metrics"]
+                   for peer, (snap, _p, _b) in results.items()}
         with open(os.path.join(args.out, "metrics.json"), "w") as f:
             json.dump(metrics, f, indent=2, sort_keys=True)
         if args.spans:
+            traces = [(peer, snap["trace"])
+                      for peer, (snap, _p, _b) in results.items()
+                      if "trace" in snap]
             merged = merge_chrome_traces(traces)
             with open(os.path.join(args.out, "trace.json"), "w") as f:
                 json.dump(merged, f)
             n = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
             print(f"wrote {args.out}/trace.json ({n} merged events)")
+        if args.bundle:
+            bundle_dir = os.path.join(args.out, "bundles")
+            for peer, (_s, _p, bun) in results.items():
+                if bun is not None:
+                    write_bundle(bun, bundle_dir)
+            print(f"wrote {bundle_dir}/ "
+                  f"({sum(1 for r in results.values() if r[2] is not None)} "
+                  "incident bundles)")
         print(f"wrote {args.out}/metrics.json "
-              f"({len(metrics)}/{len(visited)} peers)")
+              f"({len(metrics)}/{len(results) + len(failed)} peers)")
         return 1 if failed or not metrics else 0
     finally:
         rpc.close()

@@ -45,6 +45,12 @@ from moolib_tpu.telemetry import Telemetry, parse_prometheus  # noqa: E402
 # bytes in/out on each side, timeout wheel) — counted generously so the
 # budget check stays conservative as seams are added.
 GATES_PER_CALL = 32
+# Upper bound on flight-recorder (flight.on) gate consultations per echo
+# call. The recorder's seams are state TRANSITIONS (conn lifecycle,
+# resend, timeout, election...), none of which fire on a healthy echo —
+# but the budget charges a generous per-call multiple of the gate anyway
+# so the disabled-mode guarantee covers pathological paths too.
+FLIGHT_GATES_PER_CALL = 8
 
 
 def _echo_cohort(tracing: bool):
@@ -135,16 +141,17 @@ def measure_disabled_echo(calls: int) -> float:
         b.close()
 
 
-def measure_gate_ns(iters: int = 200_000) -> float:
-    """Cost of one disabled instrument-site gate (attribute load +
-    branch), in seconds — measured against an identical loop without the
-    gate so loop overhead cancels."""
-    tel = Telemetry("gatebench", enabled=False)
+def _measure_gate_ns(gated, iters: int) -> float:
+    """Cost of one disabled instrument-site gate on ``gated.on``
+    (attribute load + branch), in seconds — measured against an
+    identical loop without the gate so loop overhead cancels. ONE
+    protocol for both gate families: they share the budget, so they
+    must share the measurement."""
 
     def loop_with_gate(n):
         t0 = time.perf_counter()
         for _ in range(n):
-            if tel.on:
+            if gated.on:
                 raise AssertionError("gate should be off")
         return time.perf_counter() - t0
 
@@ -157,6 +164,45 @@ def measure_gate_ns(iters: int = 200_000) -> float:
     with_gate = min(loop_with_gate(iters) for _ in range(3))
     bare = min(loop_bare(iters) for _ in range(3))
     return max(0.0, (with_gate - bare) / iters)
+
+
+def measure_gate_ns(iters: int = 200_000) -> float:
+    """One disabled telemetry gate (``Telemetry.on``)."""
+    return _measure_gate_ns(Telemetry("gatebench", enabled=False), iters)
+
+
+def measure_flight_gate_ns(iters: int = 200_000) -> float:
+    """One disabled flight-recorder gate (``flight.on``) — the same
+    one-attribute-check discipline, measured by the same protocol."""
+    fr = Telemetry("gatebench").flight
+    fr.set_enabled(False)
+    return _measure_gate_ns(fr, iters)
+
+
+def check_flightrec_disabled_cleanliness(calls: int = 20) -> None:
+    """With the recorder gated off, an echo cohort's rings must stay
+    EMPTY through live traffic (the disabled mode is silence, not merely
+    cheapness). The recorders are disabled BEFORE listen/connect — the
+    greeting's conn_up lands on the Rpc IO thread and would race a
+    disable issued after the dial."""
+    a = Rpc("smoke-a")
+    b = Rpc("smoke-b")
+    a.telemetry.flight.set_enabled(False)
+    b.telemetry.flight.set_enabled(False)
+    b.define("echo", lambda x: x)
+    b.listen("127.0.0.1:0")
+    a.connect(b.debug_info()["listen"][0])
+    try:
+        _drive(a, calls)
+        assert len(a.telemetry.flight) == 0, (
+            f"disabled recorder captured {len(a.telemetry.flight)} events"
+        )
+        assert len(b.telemetry.flight) == 0, (
+            f"disabled recorder captured {len(b.telemetry.flight)} events"
+        )
+    finally:
+        a.close()
+        b.close()
 
 
 def main(argv=None):
@@ -176,13 +222,22 @@ def main(argv=None):
     print(f"ok   scraped both peers; echo {per_call_on * 1e6:.0f}us/call "
           f"(telemetry+tracing ON)")
 
+    print("== flightrec disabled-mode cleanliness ==")
+    check_flightrec_disabled_cleanliness()
+    print("ok   disabled recorder stayed empty through live traffic")
+
     print("== disabled-mode overhead ==")
     per_call_off = measure_disabled_echo(args.calls)
     gate = measure_gate_ns()
-    overhead = GATES_PER_CALL * gate
+    fgate = measure_flight_gate_ns()
+    # One budget for BOTH gate families: the telemetry gates plus the
+    # flight-recorder gates must together stay under the echo-latency
+    # fraction (docs/observability.md, docs/incidents.md).
+    overhead = GATES_PER_CALL * gate + FLIGHT_GATES_PER_CALL * fgate
     frac = overhead / per_call_off
     print(f"echo {per_call_off * 1e6:.0f}us/call (telemetry OFF); "
-          f"gate {gate * 1e9:.1f}ns x{GATES_PER_CALL} = "
+          f"gate {gate * 1e9:.1f}ns x{GATES_PER_CALL} + "
+          f"flight gate {fgate * 1e9:.1f}ns x{FLIGHT_GATES_PER_CALL} = "
           f"{overhead * 1e6:.3f}us/call -> {frac * 100:.3f}% "
           f"(budget {args.budget * 100:.0f}%)")
     assert frac < args.budget, (
