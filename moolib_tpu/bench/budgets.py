@@ -75,6 +75,16 @@ CPU_PROXY_BUDGETS: Dict[str, Budget] = {
     ),
     # Large-payload echo throughput: ~0.5+ GB/s loopback measured.
     "rpc_payload_gbps": Budget(value_min=0.02),
+    # The same echo over the same-host shm ring lane: multiple GB/s
+    # measured on an idle 1-core CI container (docs/perf.md records the
+    # measurement basis + the >=3x-over-TCP acceptance evidence), but
+    # heavy host contention can push either payload row well below its
+    # idle value, so this floor is a catastrophe guard only. The real
+    # fallback protection lives elsewhere: bench_rpc_shm_payload ERRORS
+    # (null row -> gate failure) when the payload bytes did not actually
+    # ride the lane, and the trend detector flags a regression against
+    # the recorded multi-GB/s history.
+    "rpc_shm_payload_gbps": Budget(value_min=0.1),
     # 4-peer loopback tree allreduce: one core pays every copy; floor is
     # far under the ~0.1+ GB/s a healthy build does at smoke sizes.
     "allreduce_tree_gbps": Budget(value_min=0.005),

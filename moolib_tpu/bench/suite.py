@@ -11,8 +11,15 @@ benchmark                       hot path it guards
 ``rpc_echo_latency_s``          RPC dispatch floor (serialize, loop hop,
                                 wire, dispatch, respond) — every control
                                 message pays it
-``rpc_payload_gbps``            large-payload RPC throughput — gradient and
-                                rollout transfers
+``rpc_payload_gbps``            large-payload RPC throughput over loopback
+                                TCP — gradient and rollout transfers
+``rpc_shm_payload_gbps``        the same payload echo over the same-host
+                                shm ring lane (spill-slot writes, zero-copy
+                                receive) — the PR-14 acceptance row
+                                (docs/perf.md records the >=3x-over-TCP
+                                evidence); the bench errors if payloads
+                                fell back to TCP, and the trend detector
+                                gates against recorded history
 ``allreduce_tree_gbps``         loopback DCN tree allreduce — the
                                 Accumulator's cross-host reduce plane
 ``batcher_fill_s``              two-stage batching fill latency — the
@@ -75,6 +82,7 @@ def _cmd(name: str, smoke: bool) -> str:
 TREND_TOLERANCE = {
     "rpc_echo_latency_s": 0.5,
     "rpc_payload_gbps": 0.5,
+    "rpc_shm_payload_gbps": 0.5,
     "allreduce_tree_gbps": 0.5,
     "batcher_fill_s": 0.5,
     "envpool_steps_per_s": 0.4,
@@ -105,7 +113,7 @@ def _result(name: str, value, unit, direction, smoke, stats=None,
 # -- RPC echo + payload -------------------------------------------------------
 
 
-def _echo_cohort():
+def _echo_cohort(transports=None):
     from ..rpc import Rpc
     from ..telemetry import Telemetry
     from ..utils import set_log_level
@@ -118,6 +126,12 @@ def _echo_cohort():
     tel = Telemetry("perfwatch-echo")
     a = Rpc("perfwatch-client", telemetry=tel)
     b = Rpc("perfwatch-server", telemetry=tel)
+    if transports is not None:
+        # Pin the lane under test: the TCP baseline row must not let the
+        # same-host shm lane silently carry its payloads (and vice versa
+        # the shm row asserts its bytes really rode shm).
+        a.set_transports(transports)
+        b.set_transports(transports)
     b.define("echo", lambda x: x)
     b.listen("127.0.0.1:0")  # OS-assigned: parallel CI jobs must coexist
     a.connect(b.debug_info()["listen"][0])
@@ -144,24 +158,95 @@ def bench_rpc_echo(smoke: bool) -> BenchResult:
         b.close()
 
 
+#: Concurrent in-flight echoes per payload-throughput rep: throughput
+#: benchmarks measure the pipelined regime (gradient pushes, rollout
+#: uploads, allreduce chunks all overlap calls), not serial round-trip
+#: latency — that's rpc_echo_latency_s's job.
+_PAYLOAD_DEPTH = 4
+
+
+def _payload_rep(a, arr, depth=_PAYLOAD_DEPTH):
+    futs = [a.async_("perfwatch-server", "echo", arr)
+            for _ in range(depth)]
+    for f in futs:
+        f.result(120)
+
+
 def bench_rpc_payload(smoke: bool) -> BenchResult:
-    """Round-trip throughput of a large tensor payload through the RPC
-    plane (client -> server -> client, so 2x the array bytes per rep)."""
+    """Pipelined round-trip throughput of large tensor payloads through
+    the RPC plane over loopback TCP (depth-4 concurrent echoes; each
+    rep moves 2 x depth x the array bytes)."""
     nbytes = (4 << 20) if smoke else (32 << 20)
-    repeats = 4 if smoke else 10
+    repeats = 4 if smoke else 8
     arr = np.ones(nbytes // 4, np.float32)
-    a, b = _echo_cohort()
+    a, b = _echo_cohort(transports={"tcp"})
     try:
         samples = measure(
-            lambda: a.sync("perfwatch-server", "echo", arr),
-            warmup=1, repeats=repeats,
+            lambda: _payload_rep(a, arr), warmup=2, repeats=repeats,
         )
         stats = trimmed_stats(samples)
-        gbps = 2 * nbytes / stats["median"] / 1e9
+        gbps = 2 * nbytes * _PAYLOAD_DEPTH / stats["median"] / 1e9
         return _result(
             "rpc_payload_gbps", gbps, "GB/s", "higher", smoke,
             stats=stats, telemetry=b.telemetry.snapshot(),
-            extra={"payload_mb": round(nbytes / 1e6, 1)},
+            extra={"payload_mb": round(nbytes / 1e6, 1),
+                   "depth": _PAYLOAD_DEPTH},
+        )
+    finally:
+        a.close()
+        b.close()
+
+
+def bench_rpc_shm_payload(smoke: bool) -> BenchResult:
+    """The rpc_payload pipelined echo over the same-host shm ring lane
+    (spill-slot writes on the sender, zero-copy mapped receive) — the
+    PR-14 acceptance row, compared against ``rpc_payload_gbps``. The
+    row errors (null value) if the payloads did not actually ride the
+    lane — a silent TCP fallback must never masquerade as an shm
+    measurement; ``extra`` carries the measured shm byte count as
+    evidence."""
+    nbytes = (4 << 20) if smoke else (32 << 20)
+    repeats = 4 if smoke else 8
+    arr = np.ones(nbytes // 4, np.float32)
+    a, b = _echo_cohort(transports={"tcp", "shm"})
+    try:
+        # The lane rendezvous rides the greeting + one offer/accept RTT.
+        a.sync("perfwatch-server", "echo", 1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            peer = a._peers.get("perfwatch-server")
+            if peer and "shm" in peer.conns:
+                break
+            time.sleep(0.02)
+        else:
+            raise RuntimeError("shm lane never came up on loopback")
+        reg = a.telemetry.registry
+        base_shm = reg.value("rpc_bytes_out_total", transport="shm") or 0
+        warmup = 2  # also settles lane EWMAs
+        samples = measure(
+            lambda: _payload_rep(a, arr), warmup=warmup, repeats=repeats,
+        )
+        shm_bytes = (
+            reg.value("rpc_bytes_out_total", transport="shm") or 0
+        ) - base_shm
+        # shm_bytes accumulated across warmup reps too (the snapshot
+        # predates measure()), so count them in `sent` — else the 0.8
+        # headroom silently loosens to ~0.5 and a run where half the
+        # measured-phase payloads fell back to TCP still passes.
+        sent = (repeats + warmup) * _PAYLOAD_DEPTH * nbytes
+        if shm_bytes < 0.8 * sent:  # headroom: the 5% exploration bandit
+            raise RuntimeError(
+                f"payloads fell back to TCP mid-run ({shm_bytes} shm "
+                f"bytes for {sent} sent)"
+            )
+        stats = trimmed_stats(samples)
+        gbps = 2 * nbytes * _PAYLOAD_DEPTH / stats["median"] / 1e9
+        return _result(
+            "rpc_shm_payload_gbps", gbps, "GB/s", "higher", smoke,
+            stats=stats, telemetry=b.telemetry.snapshot(),
+            extra={"payload_mb": round(nbytes / 1e6, 1),
+                   "depth": _PAYLOAD_DEPTH,
+                   "shm_bytes_out": int(shm_bytes)},
         )
     finally:
         a.close()
@@ -453,14 +538,20 @@ def bench_serial_encode(smoke: bool) -> BenchResult:
 
 def bench_serial_decode(smoke: bool) -> BenchResult:
     """deserialize_body() throughput on the same payload (zero-copy
-    views over the receive buffer)."""
+    views over an aligned receive buffer). ``extra`` carries the A/B
+    against the forced-copy arm (``copy_tensors=True``, the
+    pre-zero-copy behavior): ``copy_decode_gbps`` and the resulting
+    ``zero_copy_speedup`` — direct evidence the multi-MB tensor copy is
+    gone from the receive path."""
     from ..rpc import serial
 
     nbytes = (4 << 20) if smoke else (32 << 20)
     repeats = 10 if smoke else 30
     frames = serial.serialize(1, 2, _serial_payload(nbytes))
     wire = b"".join(bytes(f) for f in frames)
-    body = memoryview(wire)[serial.HEADER.size:]
+    body_arr = serial.alloc_aligned(len(wire) - serial.HEADER.size)
+    body_arr[:] = np.frombuffer(wire, np.uint8)[serial.HEADER.size:]
+    body = memoryview(body_arr)
     total = len(wire)
 
     def decode():
@@ -470,10 +561,19 @@ def bench_serial_decode(smoke: bool) -> BenchResult:
 
     samples = measure(decode, warmup=2, repeats=repeats)
     stats = trimmed_stats(samples)
+    value = total / stats["median"] / 1e9
+    # A/B control arm: same frame, tensors force-copied out.
+    copy_samples = measure(
+        lambda: serial.deserialize_body(body, copy_tensors=True),
+        warmup=1, repeats=max(3, repeats // 2),
+    )
+    copy_gbps = total / trimmed_stats(copy_samples)["median"] / 1e9
     return _result(
-        "serial_decode_gbps", total / stats["median"] / 1e9, "GB/s",
+        "serial_decode_gbps", value, "GB/s",
         "higher", smoke, stats=stats,
-        extra={"frame_mb": round(total / 1e6, 1)},
+        extra={"frame_mb": round(total / 1e6, 1),
+               "copy_decode_gbps": round(copy_gbps, 3),
+               "zero_copy_speedup": round(value / copy_gbps, 2)},
     )
 
 
@@ -621,6 +721,7 @@ def bench_serving_p99(smoke: bool) -> BenchResult:
 CPU_PROXY_SUITE: Dict[str, Callable[[bool], BenchResult]] = {
     "rpc_echo_latency_s": bench_rpc_echo,
     "rpc_payload_gbps": bench_rpc_payload,
+    "rpc_shm_payload_gbps": bench_rpc_shm_payload,
     "allreduce_tree_gbps": bench_allreduce_tree,
     "batcher_fill_s": bench_batcher_fill,
     "envpool_steps_per_s": bench_envpool_steps,

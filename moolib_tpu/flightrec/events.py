@@ -29,7 +29,7 @@ __all__ = ["KINDS", "check_event_fields"]
 KINDS: Dict[str, Tuple[str, ...]] = {
     # RPC transport (moolib_tpu/rpc/rpc.py)
     "conn_up": ("peer", "transport"),
-    "conn_down": ("peer", "why"),
+    "conn_down": ("peer", "transport", "why"),
     "call_resend": ("peer", "endpoint"),
     "call_timeout": ("peer", "endpoint"),
     # Group membership / broker authority (moolib_tpu/rpc/group.py)

@@ -19,11 +19,18 @@ Architecture notes (host control plane; device data rides XLA collectives):
   resent on reconnect, expired by a timeout thread; receivers suppress
   duplicate rids and replay cached responses (reference: Incoming/Outgoing
   buckets src/rpc.cc:1106-1184, recent-rid memory :568-597).
-- Transports: ``tcp`` and ``unix`` (abstract namespace). Per-send transport
-  choice prefers the lowest EWMA-latency live connection — the reference's
-  softmax bandit (src/rpc.cc:640-716) degenerates to this with two
-  transports; the interface (``set_transports``, per-transport latency in
-  ``debug_info``) is preserved.
+- Transports: ``tcp``, ``unix`` (abstract namespace), and ``shm`` — a
+  same-host shared-memory ring lane (:mod:`.shmring`) rendezvoused over
+  the greeting: peers advertise a host boot identity, and when it
+  matches (and both sides have shm enabled — ``MOOLIB_TPU_SHM=0``
+  disables), the peer with the smaller id creates the segment and
+  offers it over the socket lane (``FID_SHM_OFFER``/``FID_SHM_ACCEPT``).
+  Per-send transport choice prefers the lowest EWMA-latency live
+  connection — the reference's softmax bandit (src/rpc.cc:640-716)
+  degenerates to this with few transports; the interface
+  (``set_transports``, per-transport latency in ``debug_info``) is
+  preserved, and a dead shm lane simply loses its connection entry, so
+  traffic degrades to TCP instead of erroring.
 - Peer discovery: on greeting, peers exchange names + listen addresses; a
   call to an unknown peer name asks every connected peer
   ``lookingForPeer`` and connects to any address that comes back
@@ -54,7 +61,7 @@ import numpy as np
 
 from ..telemetry import Telemetry, global_telemetry, spans_to_chrome
 from ..utils import Ewma, get_logger
-from . import serial
+from . import serial, shmring
 
 log = get_logger("rpc")
 
@@ -89,6 +96,8 @@ FID_PEER_FOUND = 7
 FID_ACK = 8
 FID_NACK = 9
 FID_POKE = 10
+FID_SHM_OFFER = 11   # same-host rendezvous: creator -> attacher
+FID_SHM_ACCEPT = 12  # attacher's verdict (ok / refusal + why)
 FID_USER_BASE = 1000  # reference: reqCallOffset(1000)
 
 _DEFAULT_TIMEOUT = 30.0
@@ -441,6 +450,7 @@ class _Conn:
     __slots__ = (
         "transport", "sock", "proto", "peer_name", "peer_id", "outbound",
         "latency", "last_recv", "last_send", "created", "explicit_addr",
+        "m_out", "m_in", "m_lat", "dropped",
     )
 
     def __init__(self, transport: str, sock, proto: "_FrameProtocol",
@@ -456,6 +466,12 @@ class _Conn:
         self.last_send = time.monotonic()
         self.created = time.monotonic()
         self.explicit_addr: Optional[str] = None
+        self.dropped = False      # _drop_conn ran (idempotence latch)
+        # Per-transport wire counters + lane latency histogram
+        # (rpc_bytes_{out,in}_total{transport=}, rpc_lane_latency_seconds
+        # {transport=}), bound by the owning Rpc right after construction
+        # so the hot path pays one attribute access, not a registry probe.
+        self.m_out = self.m_in = self.m_lat = None
 
     def is_closing(self) -> bool:
         return self.sock is None or self.sock.is_closing()
@@ -510,11 +526,22 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                 sock.setsockopt(
                     pysocket.SOL_SOCKET, pysocket.SO_RCVBUF, 1 << 22
                 )
-            except OSError:
-                pass
+            except OSError as e:
+                # Never silent: an unexpectedly small socket buffer turns
+                # multi-MB frames into dozens of partial writes per
+                # message — exactly the kind of perf mystery the
+                # telemetry layer exists to surface. Record it.
+                log.debug(
+                    "%s: failed to size %s socket buffers: %s",
+                    self._rpc._name, self._transport_name, e,
+                )
+                # Unconditional (like the wheel-entry counter): a config
+                # problem must be countable even with telemetry off.
+                self._rpc._m_sockopt_fail.inc()
         self.conn = _Conn(
             self._transport_name, transport, self, self._outbound
         )
+        self._rpc._bind_lane_metrics(self.conn)
         self._rpc._register_conn(self.conn)
 
     def connection_lost(self, exc):
@@ -557,9 +584,13 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                             conn, "bad magic (corrupt stream)"
                         )
                         return
-                    # np.empty, not bytearray: bytearray(n) zero-fills,
-                    # a full extra write pass over every multi-MB body.
-                    self._body = np.empty(body_len, np.uint8)
+                    # alloc_aligned (np.empty under the hood, never
+                    # bytearray: bytearray(n) zero-fills, a full extra
+                    # write pass over every multi-MB body), 64-byte
+                    # aligned so the frame layout's body-offset padding
+                    # makes every tensor decode an aligned view — the
+                    # zero-copy receive path, no copy fallback.
+                    self._body = serial.alloc_aligned(body_len)
                     self._body_got = 0
             else:
                 self._body_got += nbytes
@@ -569,6 +600,7 @@ class _FrameProtocol(asyncio.BufferedProtocol):
                     rpc = self._rpc
                     if rpc.telemetry.on:
                         rpc._m_bytes_in.inc(serial.HEADER.size + len(body))
+                        conn.m_in.inc(serial.HEADER.size + len(body))
                     try:
                         rid, fid, obj = serial.deserialize_body(
                             memoryview(body)
@@ -673,7 +705,31 @@ class Rpc:
         # never saw it) triggers an immediate resend over the current best
         # transport (reference: processTimeout, src/rpc.cc:1414-1498).
         self._poke_min = 0.5
-        self._transports = {"tcp", "unix"}
+        self._transports = {"tcp", "unix", "shm"}
+        # Same-host shm lane policy gate: MOOLIB_TPU_SHM=0 turns the lane
+        # off for THIS peer only — it neither offers nor accepts, and
+        # interops cleanly with enabled peers (they just stay on TCP).
+        # Read per-Rpc (not at import) so tests can flip it per peer.
+        self._shm_enabled = (
+            os.environ.get("MOOLIB_TPU_SHM", "1").lower()
+            not in ("0", "false", "off", "no")
+            and shmring.shm_supported()
+        )
+        # Host identity for shm reachability gating (instance attribute so
+        # a test can spoof one peer's identity): matching boot ids is what
+        # authorizes an shm offer — a segment path means nothing across
+        # hosts.
+        self._boot_id = _BOOT_ID
+        # peer_id -> {"lane": ShmLane, "peer": name, "state":
+        # "offered"|"up"}. Lanes are per peer PAIR; the entry exists from
+        # offer (creator) / attach (attacher) until the shm conn drops or
+        # close().
+        self._shm_pairs: Dict[str, dict] = {}
+        # transport -> (bytes-out counter, bytes-in counter, lane latency
+        # histogram) — the per-transport telemetry family, cached so the
+        # wire hot path pays one dict probe per connection setup, zero
+        # per message.
+        self._lane_m: Dict[str, tuple] = {}
         self._functions: Dict[int, Tuple[str, Callable]] = {}
         self._queues: Dict[str, Queue] = {}
         self._peers: Dict[str, _Peer] = {}
@@ -740,6 +796,15 @@ class Rpc:
         # entries so the counter stays O(events).
         self._m_timeout_entries = reg.counter(
             "rpc_timeout_wheel_entries_total"
+        )
+        # Socket-buffer sizing failures (SO_SNDBUF/SO_RCVBUF rejected):
+        # always incremented — an unexpectedly small buffer is a perf
+        # mystery this counter exists to pre-answer.
+        self._m_sockopt_fail = reg.counter("rpc_sockopt_failures_total")
+        # Response-cache evictions forced by shm spill-slot pressure
+        # (see _reclaim_response_cache).
+        self._m_cache_pressure = reg.counter(
+            "rpc_response_cache_pressure_reclaims_total"
         )
         # Weakref, same contract as Group/Accumulator/EnvPoolServer: a
         # shared/global Telemetry outlives this Rpc, and a strong `self`
@@ -857,7 +922,7 @@ class Rpc:
 
     def set_transports(self, transports):
         ts = set(transports)
-        unknown = ts - {"tcp", "unix", "ipc"}
+        unknown = ts - {"tcp", "unix", "ipc", "shm"}
         if unknown:
             raise RpcError(f"unknown transports {sorted(unknown)}")
         if "ipc" in ts:  # reference naming: ipc == unix sockets
@@ -1003,6 +1068,12 @@ class Rpc:
             "name": self._name,
             "peer_id": self._peer_id,
             "addresses": list(self._listen_addrs),
+            # Same-host shm rendezvous: the boot identity gates the lane
+            # (matching ids == same kernel == the segment is mappable);
+            # "shm" advertises willingness, so a MOOLIB_TPU_SHM=0 peer
+            # interops with an enabled one by simply never rendezvousing.
+            "boot_id": self._boot_id,
+            "shm": bool(self._shm_enabled and "shm" in self._transports),
         }
         await self._write(conn, serial.serialize(0, FID_GREETING, payload))
 
@@ -1066,7 +1137,9 @@ class Rpc:
             conn.sock.writelines(frames)
             conn.last_send = time.monotonic()
             if self.telemetry.on:
-                self._m_bytes_out.inc(serial.frames_len(frames))
+                n = serial.frames_len(frames)
+                self._m_bytes_out.inc(n)
+                conn.m_out.inc(n)
             # Flow control: wait while the transport's write buffer is above
             # its high-water mark (the drain() equivalent).
             if not conn.proto._can_write.is_set():
@@ -1093,13 +1166,23 @@ class Rpc:
             conn.sock.writelines(frames)
             conn.last_send = time.monotonic()
             if self.telemetry.on:
-                self._m_bytes_out.inc(serial.frames_len(frames))
+                n = serial.frames_len(frames)
+                self._m_bytes_out.inc(n)
+                conn.m_out.inc(n)
             return True
         except (ConnectionError, OSError) as e:
             self._drop_conn(conn, f"write failed: {e}")
             return False
 
     def _drop_conn(self, conn: _Conn, why: str):
+        # Idempotence latch: one real teardown can reach here twice
+        # (e.g. an shm doorbell-write failure tears the lane down via
+        # its on_down callback, then the surfaced ConnectionError lands
+        # in _write's except) — counters, flightrec conn_down, and the
+        # chaos on_conn_drop seam must each fire exactly once per drop.
+        if conn.dropped:
+            return
+        conn.dropped = True
         log.debug("%s: drop_conn %s %s peer=%s closing=%s (%s)",
                   self._name, conn.transport,
                   "out" if conn.outbound else "in",
@@ -1108,7 +1191,8 @@ class Rpc:
             self._m_conn_drops.inc()
         if self._flight.on:
             self._flight.record("conn_down",
-                                peer=conn.peer_name or "?", why=why)
+                                peer=conn.peer_name or "?",
+                                transport=conn.transport, why=why)
         if self._faults is not None:
             # Observation-only: scenario engines log the teardown. Hook
             # errors are swallowed here on purpose — _drop_conn must
@@ -1121,6 +1205,25 @@ class Rpc:
             except Exception as e:
                 log.error("fault hook failed on conn drop: %s", e)
         conn.close()
+        if conn.transport == "shm" and conn.peer_id is not None:
+            # The lane dies with its conn: free the pair slot so a future
+            # reconnect/greeting can rendezvous a fresh lane. (conn.close
+            # above already closed the lane, unlinking creator files.)
+            entry = self._shm_pairs.get(conn.peer_id)
+            if entry is not None and entry.get("lane") is conn.sock:
+                self._shm_pairs.pop(conn.peer_id, None)
+        elif conn.peer_id is not None:
+            # A socket conn died mid-rendezvous: an entry stuck in
+            # "offered" whose offer/accept rode THIS conn can never
+            # complete (the reply was pinned to the dead stream) — free
+            # the slot and the never-used segment, or every future
+            # greeting hits `peer_id in self._shm_pairs` and the pair is
+            # stuck on TCP for the life of the process.
+            entry = self._shm_pairs.get(conn.peer_id)
+            if (entry is not None and entry.get("state") == "offered"
+                    and entry.get("conn") is conn):
+                self._shm_pairs.pop(conn.peer_id, None)
+                entry["lane"].close()
         if conn in self._anon_conns:
             self._anon_conns.remove(conn)
         if conn.explicit_addr is not None:
@@ -1198,6 +1301,10 @@ class Rpc:
             self._on_peer_found(obj)
         elif fid == FID_POKE:
             self._on_poke(conn, rid)
+        elif fid == FID_SHM_OFFER:
+            self._on_shm_offer(conn, obj)
+        elif fid == FID_SHM_ACCEPT:
+            self._on_shm_accept(conn, obj)
         elif fid == FID_ACK:
             out = self._outgoing.get(rid)
             if out is not None:
@@ -1244,10 +1351,17 @@ class Rpc:
                 self._drop_conn(conn, "peer name collision")
                 return
             # Restarted incarnation reusing the name: stale addresses and
-            # dead conns belong to the old identity — start clean.
+            # dead conns belong to the old identity — start clean. An shm
+            # lane offered to (or shared with) the dead incarnation is
+            # garbage too: the shm conn drop above pops established
+            # lanes; sweep any still-pending offer by peer name.
             existing.addresses.clear()
             for old_conn in list(existing.conns.values()):
                 self._drop_conn(old_conn, "stale incarnation")
+            for pid, entry in list(self._shm_pairs.items()):
+                if entry.get("peer") == name:
+                    self._shm_pairs.pop(pid, None)
+                    entry["lane"].close()
         conn.peer_name = name
         conn.peer_id = obj["peer_id"]
         if conn in self._anon_conns:
@@ -1284,6 +1398,9 @@ class Rpc:
                                 transport=conn.transport)
         if peer.found_event is not None:
             peer.found_event.set()
+        # Same-host rendezvous: maybe open the zero-copy shm lane
+        # alongside this socket lane (transport selection arbitrates).
+        self._maybe_offer_shm(conn, obj)
         # Flush anything waiting on this peer.
         self._loop.create_task(self._flush_unrouted(peer))
 
@@ -1338,6 +1455,177 @@ class Rpc:
                     continue  # next address
                 if peer.conns:
                     return
+
+    # -- same-host shm lane (rendezvous + delivery) --------------------------
+
+    def _bind_lane_metrics(self, conn: _Conn):
+        """Attach the per-transport telemetry family to a fresh conn —
+        one registry probe at connection setup, one attribute access per
+        message after."""
+        m = self._lane_m.get(conn.transport)
+        if m is None:
+            reg = self.telemetry.registry
+            m = (
+                reg.counter("rpc_bytes_out_total",
+                            transport=conn.transport),
+                reg.counter("rpc_bytes_in_total",
+                            transport=conn.transport),
+                reg.histogram("rpc_lane_latency_seconds",
+                              transport=conn.transport),
+            )
+            self._lane_m[conn.transport] = m
+        conn.m_out, conn.m_in, conn.m_lat = m
+
+    def _maybe_offer_shm(self, conn: _Conn, obj: dict):
+        """Creator side of the rendezvous — LOOP THREAD ONLY. Runs on
+        every greeting; a lane is offered when both peers are shm-willing
+        and share a boot identity, and this peer holds the smaller id
+        (one deterministic creator per pair, no cross-offer races)."""
+        if not self._shm_enabled or "shm" not in self._transports:
+            return
+        if not obj.get("shm") or obj.get("boot_id") != self._boot_id:
+            return
+        peer_id = obj["peer_id"]
+        if self._peer_id >= peer_id or peer_id in self._shm_pairs:
+            return
+        try:
+            lane = shmring.ShmLane.create()
+        except (OSError, ValueError) as e:
+            log.debug("%s: shm lane create failed (%s); staying on %s",
+                      self._name, e, conn.transport)
+            return
+        self._shm_pairs[peer_id] = {
+            "lane": lane, "peer": conn.peer_name, "state": "offered",
+            # The rendezvous conversation is pinned to this socket (the
+            # attacher replies on the conn the offer arrived on): if it
+            # dies first, the accept can never arrive — _drop_conn frees
+            # the slot so the next greeting offers a fresh lane.
+            "conn": conn,
+        }
+        payload = lane.offer_payload()
+        payload["boot_id"] = self._boot_id
+        self._loop.create_task(
+            self._write(conn, serial.serialize(0, FID_SHM_OFFER, payload))
+        )
+
+    def _on_shm_offer(self, conn: _Conn, obj):
+        """Attacher side: map the creator's segment, mount the lane, and
+        answer. Any failure is a refusal, never an error — both sides
+        then simply stay on the socket lanes."""
+        ok, why = False, ""
+        if conn.peer_name is None:
+            why = "offer before greeting"
+        elif not self._shm_enabled or "shm" not in self._transports:
+            why = "shm disabled"
+        elif obj.get("boot_id") != self._boot_id:
+            why = "different host (boot id mismatch)"
+        elif conn.peer_id in self._shm_pairs:
+            why = "lane already exists"
+        else:
+            try:
+                lane = shmring.ShmLane.attach(obj)
+                self._shm_pairs[conn.peer_id] = {
+                    "lane": lane, "peer": conn.peer_name, "state": "up",
+                }
+                self._register_shm_conn(
+                    conn.peer_name, conn.peer_id, lane, outbound=False
+                )
+                ok = True
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                why = f"attach failed: {type(e).__name__}: {e}"
+                log.debug("%s: refusing shm offer from %s: %s",
+                          self._name, conn.peer_name, why)
+        self._loop.create_task(
+            self._write(conn, serial.serialize(
+                0, FID_SHM_ACCEPT, {"ok": ok, "why": why}
+            ))
+        )
+
+    def _on_shm_accept(self, conn: _Conn, obj):
+        """Creator side: the attacher's verdict. ok -> mount our half;
+        refusal -> tear the never-used lane down (unlinks the segment)."""
+        entry = self._shm_pairs.get(conn.peer_id)
+        if entry is None or entry.get("state") != "offered":
+            return
+        lane = entry["lane"]
+        if not (isinstance(obj, dict) and obj.get("ok")):
+            log.debug("%s: shm offer refused by %s: %s", self._name,
+                      conn.peer_name,
+                      obj.get("why") if isinstance(obj, dict) else obj)
+            self._shm_pairs.pop(conn.peer_id, None)
+            lane.close()
+            return
+        try:
+            lane.open_tx()
+        except OSError as e:
+            log.debug("%s: shm doorbell open failed: %s", self._name, e)
+            self._shm_pairs.pop(conn.peer_id, None)
+            lane.close()
+            return
+        entry["state"] = "up"
+        entry.pop("conn", None)  # rendezvous done: stop pinning the socket
+        # Both sides are mounted (the attacher opened everything before
+        # its accept, open_tx just completed): drop the /dev/shm names
+        # now so no SIGKILL of either peer can ever leak them.
+        lane.unlink_now()
+        self._register_shm_conn(
+            conn.peer_name, conn.peer_id, lane, outbound=True
+        )
+
+    def _register_shm_conn(self, peer_name: str, peer_id: str,
+                           lane, outbound: bool) -> _Conn:
+        """Mount a ready lane as a live connection: from here on the shm
+        lane is an ordinary transport — EWMA selection, keepalives,
+        fault-hook seams, resend-on-drop all apply unchanged."""
+        conn = _Conn("shm", lane, lane, outbound)
+        conn.peer_name = peer_name
+        conn.peer_id = peer_id
+        self._bind_lane_metrics(conn)
+        peer = self._peers.setdefault(peer_name, _Peer(peer_name))
+        old = peer.conns.get("shm")
+        if old is not None and old is not conn:
+            self._drop_conn(old, "replaced by newer shm lane")
+        peer.conns["shm"] = conn
+        lane.set_reclaim(self._reclaim_response_cache)
+        lane.start(
+            self._loop,
+            lambda wire: self._shm_deliver(conn, wire),
+            lambda why: self._drop_conn(conn, f"shm lane down: {why}"),
+        )
+        if self._flight.on:
+            self._flight.record("conn_up", peer=peer_name, transport="shm")
+        log.debug("%s: shm lane up to %s (%s)", self._name, peer_name,
+                  lane.path)
+        self._loop.create_task(self._flush_unrouted(peer))
+        return conn
+
+    def _shm_deliver(self, conn: _Conn, wire: memoryview):
+        """Per-frame delivery from the lane's ring drain — LOOP THREAD
+        ONLY, the shm mirror of ``_FrameProtocol.buffer_updated``: same
+        telemetry, same recv fault seam (via ``_dispatch``), same
+        drop-the-conn containment for decode errors."""
+        conn.last_recv = time.monotonic()
+        if self.telemetry.on:
+            self._m_bytes_in.inc(len(wire))
+            conn.m_in.inc(len(wire))
+        try:
+            magic, body_len = serial.HEADER.unpack(
+                wire[:serial.HEADER.size]
+            )
+            if magic != serial.MAGIC or (
+                body_len != len(wire) - serial.HEADER.size
+            ):
+                raise ValueError("bad shm frame header")
+            rid, fid, obj = serial.deserialize_body(
+                wire[serial.HEADER.size:]
+            )
+            self._dispatch(conn, rid, fid, obj)
+        # Sync lane callback (no awaits): a decode/dispatch error must
+        # drop the lane (degrading to TCP), never escape into the drain.
+        except Exception as e:  # moolint: disable=swallow-cancelled
+            log.error("shm frame dispatch error on %s: %s",
+                      conn.peer_name, e)
+            self._drop_conn(conn, f"protocol error: {e}")
 
     # -- requests (server side) ---------------------------------------------
 
@@ -1485,6 +1773,25 @@ class Rpc:
                 _k, evicted = self._response_cache.popitem(last=False)
                 self._response_cache_bytes -= serial.frames_len(evicted)
 
+    def _reclaim_response_cache(self):
+        """Shm slot-pressure reclaim (mounted on every lane): cached
+        exactly-once replies hold zero-copy views over spill slots, so a
+        full cache can pin a whole direction's slots and starve the
+        peer's allocator into the slow chunked path. Shed the oldest
+        half (by bytes) — the accepted degradation is the same as
+        ordinary cache eviction: a replay of an evicted reply gets the
+        explicit evicted-reply error (see ``_on_poke``), never
+        re-execution, and the freed views release their slots
+        synchronously via refcount."""
+        if self.telemetry.on:
+            self._m_cache_pressure.inc()
+        with self._response_cache_lock:
+            target = self._response_cache_bytes / 2
+            while (self._response_cache
+                   and self._response_cache_bytes > target):
+                _k, evicted = self._response_cache.popitem(last=False)
+                self._response_cache_bytes -= serial.frames_len(evicted)
+
     def _on_poke(self, conn: _Conn, rid: int):
         """Server side of the poke protocol: the client asks whether we ever
         received request ``rid``. Known + answered -> replay the cached
@@ -1515,9 +1822,23 @@ class Rpc:
         if out is None:
             return
         rtt = time.monotonic() - out.sent_at
-        conn.latency.add(rtt)
+        # Attribute the RTT to the lane that carried the REQUEST, not
+        # whichever lane the server chose for the reply: with multiple
+        # lanes per peer (shm + tcp) the reply often rides a different
+        # one, and crediting the arrival lane would leave the request
+        # lane's EWMA forever unmeasured at 0.0 — argmin would then pin
+        # all traffic to it blind. An unmeasured lane still attracts
+        # exactly one probe call (EWMA 0.0 wins its first argmin tie).
+        lane = out.conn if (
+            out.conn is not None and not out.conn.is_closing()
+        ) else conn
+        lane.latency.add(rtt)
         tel = self.telemetry
         if tel.on:
+            # Lane-labelled RTT: the same sample the EWMA transport
+            # selector consumes, exported per transport so the shm-vs-tcp
+            # arbitration is observable (docs/observability.md).
+            lane.m_lat.observe(rtt)
             cm = self._tel_client.get(out.fname)
             if cm is not None:
                 # Full-call latency (submission to response, resends
@@ -1859,13 +2180,20 @@ class Rpc:
     def _next_check(self, out: _Outgoing, now: float) -> float:
         """Earliest future instant this call needs attention: unrouted
         calls retry every tick; un-acked ones at their next poke time;
-        acked ones only at the deadline."""
+        acked ones on a slower re-poke grace."""
         if out.conn is None:
             return now + self._TICK
-        if out.acked:
-            return out.deadline
         lat = out.conn.latency.value or 0.0
         poke_after = min(max(4.0 * lat, self._poke_min), self._timeout / 2)
+        if out.acked:
+            # An ACK means "received, still executing" — NOT "the reply
+            # is guaranteed to arrive": the reply can still die with the
+            # connection that carries it (e.g. a zombie shm lane the
+            # server wrote into before noticing peer death). Re-poke on
+            # a 4x grace so a lost reply degrades to a bounded re-ask
+            # (cached-response replay), never a silent wait until the
+            # call deadline.
+            poke_after = max(4.0 * poke_after, 2.0)
         return min(out.deadline, max(out.sent_at, out.poked_at) + poke_after)
 
     async def _timeout_loop(self):
@@ -1923,17 +2251,24 @@ class Rpc:
                                 f"{out.fname!r} (reroute disabled)"
                             ))
                             continue
-                    elif not out.acked:
-                        # Unanswered and un-acked: poke the server after a
+                    else:
+                        # Unanswered: poke the server after a
                         # latency-scaled silence so a request lost in a
                         # connection handover is resent well before the
                         # deadline (reference: src/rpc.cc:1414-1498).
+                        # ACKed calls re-poke too, on a 4x grace (see
+                        # _next_check): the reply itself can be lost with
+                        # the lane that carried it, and the re-ask
+                        # replays the cached response.
                         lat = out.conn.latency.value or 0.0
                         poke_after = min(
                             max(4.0 * lat, self._poke_min), self._timeout / 2
                         )
+                        if out.acked:
+                            poke_after = max(4.0 * poke_after, 2.0)
                         if now - max(out.sent_at, out.poked_at) > poke_after:
                             out.poked_at = now
+                            out.acked = False  # re-arm: answer or re-ACK
                             peer = self._peers.get(out.peer_name)
                             conn = _best_conn(peer) if peer and peer.conns \
                                 else None
@@ -2168,6 +2503,13 @@ class Rpc:
                     conn.close()
             for conn in self._anon_conns:
                 conn.close()
+            # Mounted lanes closed with their conns above; this sweeps
+            # offered-but-never-accepted lanes so the creator's segment
+            # and doorbell files are unlinked deterministically (the
+            # weakref finalizer is only the abandoned-object backstop).
+            for entry in list(self._shm_pairs.values()):
+                entry["lane"].close()
+            self._shm_pairs.clear()
             for server in self._servers:
                 server.close()
             self._loop.stop()
@@ -2257,9 +2599,16 @@ _BANDIT_EXPLORE = 0.05
 _bandit_rng = _pyrandom.Random(0x6D6F6F)
 
 
+#: Tie-break order among equal-EWMA transports: shm (zero-copy, no
+#: kernel round-trips) over unix over tcp. Fresh lanes all start at
+#: EWMA 0.0, so this rank also decides which unmeasured lane gets the
+#: first send — after which real samples take over.
+_TRANSPORT_RANK = {"shm": 0, "unix": 1, "tcp": 2}
+
+
 def _best_conn(peer: _Peer) -> Optional[_Conn]:
-    """Min-EWMA-latency live connection (unix wins ties), with epsilon
-    softmax exploration across transports."""
+    """Min-EWMA-latency live connection (shm, then unix, wins ties),
+    with epsilon softmax exploration across transports."""
     conns = list(peer.conns.items())
     if not conns:
         return None
@@ -2278,7 +2627,7 @@ def _best_conn(peer: _Peer) -> Optional[_Conn]:
         return conns[-1][1]
     best, best_key = None, None
     for t, conn in conns:
-        key = (conn.latency.value, 0 if t == "unix" else 1)
+        key = (conn.latency.value, _TRANSPORT_RANK.get(t, 3))
         if best_key is None or key < best_key:
             best, best_key = conn, key
     return best

@@ -18,12 +18,28 @@ Frame layout:
 
     u32 MAGIC | u64 body_len | body
     body = u64 rid | u32 fid | u32 n_tensors | u64 meta_len | meta
-           | per tensor: u64 nbytes | pad to 64 | data | pad to 64
+           | pad to 64 | per tensor: u64 nbytes | pad to 64 | data | pad to 64
 
 Metadata is a 1-byte-tagged recursive encoding covering the same type set as
 the reference's ``pyTypes`` (None/bool/int/float/str/bytes/list/tuple/dict/
 tensor/pickle-fallback); ndarray/jax.Array leaves encode dtype+shape in-line
 and reference their payload by index.
+
+The pad after ``meta`` is measured from the START of the body, so every
+tensor payload sits at a 64-byte-aligned *body offset* regardless of the
+metadata's length; receivers that place the body in a 64-byte-aligned
+buffer (:func:`alloc_aligned` — the RPC frame protocol and the shm ring
+lane both do) therefore get dtype-aligned zero-copy views from
+``_decode_tensor`` with no copy fallback on the hot path.
+
+Zero-copy receive contract: tensor leaves decoded by
+:func:`deserialize_body` are numpy views ALIASING the receive buffer
+(the TCP reassembly buffer or a shared-memory spill slot). Callers must
+treat them as read-only — mutating one in place corrupts the buffer for
+every other view of the same message (and, on the shm lane, memory the
+sending process still owns); copy first (``np.array(x)``) to mutate.
+The views keep the backing buffer alive, so holding a decoded tensor
+pins the whole message body (and, on the shm lane, its spill slot).
 """
 
 from __future__ import annotations
@@ -37,6 +53,7 @@ import numpy as np
 __all__ = [
     "MAGIC",
     "HEADER",
+    "alloc_aligned",
     "serialize",
     "deserialize_body",
     "frames_len",
@@ -208,12 +225,25 @@ def _decode(r: _Reader, tensors: List[np.ndarray]) -> Any:
 
 def _decode_tensor(r: _Reader, tensors: List[np.ndarray]) -> np.ndarray:
     """Shared by the pure-Python decoder and the native decoder's fallback:
-    one place owns the tensor wire layout."""
+    one place owns the tensor wire layout.
+
+    Returns a zero-copy view aliasing the receive buffer whenever the
+    payload's address is aligned for the target dtype (the frame layout
+    64-byte-aligns every tensor's *body offset*, so with an aligned
+    receive buffer — :func:`alloc_aligned` — this is the only path
+    taken); an unaligned payload (a caller decoding out of an arbitrary
+    bytes offset) falls back to one copy so the returned array is always
+    dtype-aligned. Callers must not mutate the view (see the module
+    docstring's zero-copy receive contract)."""
     idx, ndim = r.unpack(_IB)
     shape = tuple(r.unpack(_Q)[0] for _ in range(ndim))
     (dtlen,) = r.unpack(_B)
     dt = np.dtype(bytes(r.take(dtlen)).decode())
-    return tensors[idx].view(dt).reshape(shape)
+    raw = tensors[idx]
+    if dt.itemsize > 1 and raw.ctypes.data % dt.alignment:
+        raw = raw.copy()  # unaligned source: one copy beats an unaligned
+        # view (jitted consumers fault or crawl on unaligned loads)
+    return raw.view(dt).reshape(shape)
 
 
 def _decode_pickled(r: _Reader) -> Any:
@@ -222,6 +252,17 @@ def _decode_pickled(r: _Reader) -> Any:
 
 
 _PAD = b"\x00" * _ALIGN
+
+
+def alloc_aligned(nbytes: int, align: int = _ALIGN) -> np.ndarray:
+    """A zeroed-length-free uint8 buffer of ``nbytes`` whose data pointer
+    is ``align``-byte aligned — the receive-buffer allocator for every
+    lane (TCP frame reassembly, shm inline/chunk staging), pairing with
+    the frame layout's body-offset alignment so ``_decode_tensor`` can
+    return aligned views instead of copies."""
+    buf = np.empty(nbytes + align, np.uint8)
+    off = (-buf.ctypes.data) % align
+    return buf[off:off + nbytes]
 
 
 def _get_native():
@@ -311,8 +352,15 @@ def serialize(rid: int, fid: int, obj: Any) -> List[Any]:
         tensor_bytes += len(head) + pad1 + nb + pad2
 
     body_head = _BODY_HEAD.pack(rid, fid, len(tensors), len(meta))
-    body_len = len(body_head) + len(meta) + tensor_bytes
-    out: List[Any] = [HEADER.pack(MAGIC, body_len) + body_head + meta]
+    # Pad meta so the tensor section starts at a 64-byte-aligned BODY
+    # offset (body_head is 24 bytes, each tensor block is internally
+    # 64-padded): with an aligned receive buffer every tensor payload
+    # lands dtype-aligned and decodes as a view, never a copy.
+    meta_pad = -(_BODY_HEAD.size + len(meta)) % _ALIGN
+    body_len = len(body_head) + len(meta) + meta_pad + tensor_bytes
+    out: List[Any] = [
+        HEADER.pack(MAGIC, body_len) + body_head + meta + _PAD[:meta_pad]
+    ]
     out.extend(tensor_parts)
     return out
 
@@ -321,16 +369,22 @@ def frames_len(frames: List[Any]) -> int:
     return sum(len(f) for f in frames)
 
 
-def deserialize_body(body: memoryview) -> Tuple[int, int, Any]:
+def deserialize_body(body: memoryview, *,
+                     copy_tensors: bool = False) -> Tuple[int, int, Any]:
     """Decode a message body (everything after the 12-byte frame header).
 
     Tensor leaves are numpy views aliasing ``body`` (zero-copy): valid as
     long as the receive buffer is alive, which the caller guarantees by
-    handing ownership of ``body``'s base to the decoded message consumer.
+    handing ownership of ``body``'s base to the decoded message consumer
+    — and the consumer must not mutate them (module docstring contract).
+    ``copy_tensors=True`` forces one copy per tensor payload instead (the
+    pre-zero-copy behavior) — kept for consumers that need detached
+    arrays and as the serial bench's A/B control arm.
     """
     r = _Reader(memoryview(body))
     rid, fid, n_tensors, meta_len = r.unpack(_BODY_HEAD)
     meta_view = r.take(meta_len)
+    r.take(-(_BODY_HEAD.size + meta_len) % _ALIGN)  # meta alignment pad
     # Tensor payload section begins after meta; parse it first so decode can
     # reference tensors by index.
     tensors: List[np.ndarray] = []
@@ -339,6 +393,7 @@ def deserialize_body(body: memoryview) -> Tuple[int, int, Any]:
         r.take(-_Q.size % _ALIGN)
         data = r.take(nb)
         r.take(-nb % _ALIGN)
-        tensors.append(np.frombuffer(data, dtype=np.uint8))
+        arr = np.frombuffer(data, dtype=np.uint8)
+        tensors.append(arr.copy() if copy_tensors else arr)
     obj = _decode_toplevel(meta_view, tensors)
     return rid, fid, obj

@@ -59,6 +59,8 @@ from ..rpc.rpc import (
     FID_NACK,
     FID_PEER_FOUND,
     FID_POKE,
+    FID_SHM_ACCEPT,
+    FID_SHM_OFFER,
     FID_SUCCESS,
     fid_for,
 )
@@ -82,6 +84,8 @@ CONTROL_NAMES = {
     FID_ACK: "@ack",
     FID_NACK: "@nack",
     FID_POKE: "@poke",
+    FID_SHM_OFFER: "@shmOffer",
+    FID_SHM_ACCEPT: "@shmAccept",
 }
 
 #: One injected event. ``seq`` is a per-plan monotonic counter; ``arg``
@@ -590,11 +594,15 @@ class ChaosNet:
 
     # -- imperative faults ----------------------------------------------------
 
-    def kill_conns(self, rpc, peer: str = "*", wait: float = 5.0) -> int:
+    def kill_conns(self, rpc, peer: str = "*", wait: float = 5.0,
+                   transport: str = "*") -> int:
         """Kill ``rpc``'s live connections to peers matching ``peer`` (an
         injected connection loss — reconnect/resend machinery takes over).
-        Returns the number of connections killed; blocks up to ``wait``
-        seconds for the teardown to run on the IO loop."""
+        ``transport`` narrows the kill to matching lanes (e.g. ``"shm"``
+        for the segment-death scenario: the socket lanes survive and
+        in-flight traffic must fail over onto them). Returns the number
+        of connections killed; blocks up to ``wait`` seconds for the
+        teardown to run on the IO loop."""
         result: Dict[str, int] = {}
         done = threading.Event()
 
@@ -605,10 +613,14 @@ class ChaosNet:
                     if not fnmatchcase(p.name, peer):
                         continue
                     for conn in list(p.conns.values()):
+                        if not fnmatchcase(conn.transport, transport):
+                            continue
                         rpc._drop_conn(conn, "chaos: injected conn kill")
                         n += 1
                 if peer == "*":
                     for conn in list(rpc._anon_conns):
+                        if not fnmatchcase(conn.transport, transport):
+                            continue
                         rpc._drop_conn(conn, "chaos: injected conn kill")
                         n += 1
             finally:

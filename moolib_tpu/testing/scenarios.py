@@ -35,6 +35,7 @@ __all__ = [
     "scenario_learner_restart",
     "scenario_broker_failover",
     "scenario_straggler_quorum",
+    "scenario_shm_lane_fallback",
     "scenario_replica_kill",
     "scenario_router_partition",
     "scenario_envpool_worker_kill",
@@ -693,6 +694,133 @@ def scenario_straggler_quorum(seed: int) -> Dict[str, int]:
         if net is not None:
             net.detach_all()
         cluster.close()
+
+
+def _await_shm_lane(a: Rpc, b: Rpc, timeout: float = 10.0):
+    """Wait until the zero-copy shm lane is mounted on BOTH peers (the
+    rendezvous rides the greeting + one offer/accept round trip)."""
+    def up(x: Rpc, peer: str) -> bool:
+        p = x._peers.get(peer)
+        return bool(p and "shm" in p.conns
+                    and not p.conns["shm"].is_closing())
+
+    _await(lambda: up(a, b.get_name()) and up(b, a.get_name()), timeout,
+           "shm lane never came up between "
+           f"{a.get_name()} and {b.get_name()}")
+
+
+def scenario_shm_lane_fallback(seed: int, calls: int = 6) -> Dict[str, int]:
+    """Kill the same-host shm lane on both peers while calls are in
+    flight on it (the segment-death / peer-death failure class,
+    docs/reliability.md): every stranded call is resent over the
+    surviving TCP lane and completes EXACTLY once (duplicate rids
+    suppressed server-side), the dead lane's /dev/shm entries are
+    unlinked (no segment leak), the lane never silently resurrects, and
+    the injected-event log is deterministic — exactly one scripted
+    conn_kill per side, every run, for any seed."""
+    import os as _os
+
+    host = Rpc("shmhost")
+    host.listen("127.0.0.1:0")
+    gate = threading.Event()
+    executed = []
+    lock = threading.Lock()
+
+    def work(x):
+        # Hold the (single-worker) executor until the kill lands so the
+        # whole batch is provably in flight across the lane teardown.
+        gate.wait(15)
+        with lock:
+            executed.append(int(x[0]))
+        return x * 2.0
+
+    host.define("work", work)
+    client = Rpc("shmclient")
+    client._poke_min = 0.2
+    client.set_timeout(20.0)
+    client.connect(host.debug_info()["listen"][0])
+    plan = FaultPlan(seed)
+    net = ChaosNet(plan, [client, host])
+    try:
+        _await_shm_lane(client, host)
+        lane_paths = [
+            e["lane"].path for e in list(client._shm_pairs.values())
+        ] + [e["lane"].path for e in list(host._shm_pairs.values())]
+        assert lane_paths, "no shm lane paths to watch for leaks"
+
+        # Spill-sized payloads: the calls ride the shm lane's zero-copy
+        # slot path (fresh lanes tie on EWMA and shm wins the tie).
+        futs = [
+            client.async_("shmhost", "work",
+                          np.full((1 << 18,), float(i), np.float32))
+            for i in range(calls)
+        ]
+        hreg = host.telemetry.registry
+        _await(lambda: (hreg.value("rpc_server_calls_total",
+                                   endpoint="work") or 0) >= calls,
+               15, "calls never reached the server over the shm lane")
+        shm_out = client.telemetry.registry.value(
+            "rpc_bytes_out_total", transport="shm") or 0
+        # Headroom mirrors bench_rpc_shm_payload's 0.8 margin: the
+        # per-send exploration bandit (global RNG, ~2.5%/call) may
+        # legally route a payload or two over TCP — those calls simply
+        # are not stranded by the kill; requiring most (not all) of the
+        # ~1 MB payloads on the lane keeps the scenario deterministic
+        # in its assertions without depending on the RNG stream position.
+        assert shm_out > (calls - 2) * (1 << 20), (
+            f"payloads did not ride the shm lane ({shm_out} bytes)"
+        )
+
+        # Segment death, both sides: only the shm lane dies; TCP survives.
+        assert net.kill_conns(client, "shmhost", transport="shm") == 1
+        assert net.kill_conns(host, "shmclient", transport="shm") == 1
+        gate.set()
+
+        # Exactly-once completion over the TCP fallback.
+        for i, f in enumerate(futs):
+            out = f.result(timeout=30)
+            assert float(out[0]) == 2.0 * i, (
+                f"call {i} lost or corrupted across the lane kill: {out}"
+            )
+        with lock:
+            assert sorted(executed) == list(range(calls)), (
+                f"exactly-once violated across the shm->tcp fallback: "
+                f"{sorted(executed)}"
+            )
+        creg = client.telemetry.registry
+        assert (creg.value("rpc_resends_total") or 0) >= 1, (
+            "stranded calls were never resent onto the TCP lane"
+        )
+
+        # The lane is gone (no silent resurrection without a reconnect)
+        # and its filesystem entries are unlinked — no /dev/shm leak.
+        for rpc, peer in ((client, "shmhost"), (host, "shmclient")):
+            conns = rpc._peers[peer].conns
+            assert "shm" not in conns, (
+                f"{rpc.get_name()} still holds an shm conn after the kill"
+            )
+        for path in lane_paths:
+            for suffix in ("", ".db0", ".db1"):
+                assert not _os.path.exists(path + suffix), (
+                    f"shm lane leaked {path + suffix} after death"
+                )
+
+        # A post-kill call rides TCP (the degraded steady state works).
+        assert client.sync("shmhost", "work", np.zeros(2, np.float32))[
+            0] == 0.0
+
+        # Replay determinism: the only injections are the two scripted
+        # lane kills — identical log for identical seeds, every run.
+        assert [(e.kind, e.arg) for e in plan.events] == [
+            ("conn_kill", 1), ("conn_kill", 1)
+        ], f"unexpected injected-event log: {plan.events}"
+        plan.verify_telemetry()  # registry counters == injected log
+        return plan.summary()
+    finally:
+        gate.set()
+        net.detach_all()
+        client.close()
+        host.close()
 
 
 # -- serving tier ------------------------------------------------------------
@@ -1355,6 +1483,7 @@ SCENARIOS = {
     "learner_restart": scenario_learner_restart,
     "broker_failover": scenario_broker_failover,
     "straggler_quorum": scenario_straggler_quorum,
+    "shm_lane_fallback": scenario_shm_lane_fallback,
     "replica_kill": scenario_replica_kill,
     "router_partition": scenario_router_partition,
     "envpool_worker_kill": scenario_envpool_worker_kill,

@@ -541,6 +541,18 @@ def test_chaos_broker_failover_promotes_standby():
     assert summary == {"conn_kill": 1}, summary
 
 
+def test_chaos_shm_lane_fallback():
+    """Same-host shm lane killed on both peers mid-call (segment death):
+    stranded calls resend over the surviving TCP lane and complete
+    exactly once, the lane's /dev/shm entries are unlinked, and the
+    injected-event log is deterministic (one scripted conn_kill per
+    side)."""
+    from moolib_tpu.testing.scenarios import scenario_shm_lane_fallback
+
+    summary = scenario_shm_lane_fallback(seed=606)
+    assert summary == {"conn_kill": 2}, summary
+
+
 def test_chaos_straggler_quorum_commit():
     """Straggler slow-link quorum commit: with min_quorum=2 the cohort
     commits a gradient round with N-1 contributions at the straggler

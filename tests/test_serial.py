@@ -99,3 +99,58 @@ def test_noncontiguous_tensor(rng):
     a = rng.standard_normal((6, 8))[::2, 1::3]
     out = _roundtrip(a)
     np.testing.assert_array_equal(out, a)
+
+
+def test_decode_is_zero_copy_and_aligned(rng):
+    """The zero-copy receive contract: with an aligned receive buffer
+    (serial.alloc_aligned — what every lane uses), decoded tensors are
+    ALIGNED views sharing memory with the body, for every dtype width and
+    any metadata length (the layout pads meta to a 64-byte body offset)."""
+    for meta_junk in ("", "x", "abcdefghijk"):  # perturb meta length
+        obj = {
+            "pad": meta_junk,
+            "f64": rng.standard_normal(1 << 12),
+            "f32": rng.standard_normal(1 << 12).astype(np.float32),
+            "u8": rng.integers(0, 255, 1 << 12).astype(np.uint8),
+        }
+        frames = serial.serialize(1, 2, obj)
+        blob = b"".join(bytes(f) for f in frames)
+        body = serial.alloc_aligned(len(blob) - serial.HEADER.size)
+        body[:] = np.frombuffer(blob, np.uint8)[serial.HEADER.size:]
+        _rid, _fid, out = serial.deserialize_body(memoryview(body))
+        for k in ("f64", "f32", "u8"):
+            assert np.shares_memory(out[k], body), (
+                f"{k} was copied out of the receive buffer"
+            )
+            assert out[k].flags.aligned, f"{k} decoded unaligned"
+            np.testing.assert_array_equal(out[k], obj[k])
+
+
+def test_decode_unaligned_buffer_falls_back_to_copy(rng):
+    """Decoding from a deliberately misaligned buffer returns CORRECT,
+    aligned arrays — via the one-copy fallback, never an unaligned view."""
+    a = rng.standard_normal(1 << 10)  # f64: alignment 8
+    frames = serial.serialize(1, 2, a)
+    blob = b"".join(bytes(f) for f in frames)
+    base = serial.alloc_aligned(len(blob) + 1)
+    base[1:] = np.frombuffer(blob, np.uint8)
+    body = memoryview(base)[1 + serial.HEADER.size:]  # odd offset
+    _rid, _fid, out = serial.deserialize_body(body)
+    assert out.flags.aligned
+    np.testing.assert_array_equal(out, a)
+
+
+def test_decode_copy_tensors_ab(rng):
+    """copy_tensors=True (the bench A/B control arm) detaches every
+    tensor from the receive buffer; identical values either way."""
+    obj = {"x": rng.standard_normal(1 << 14).astype(np.float32)}
+    frames = serial.serialize(1, 2, obj)
+    blob = b"".join(bytes(f) for f in frames)
+    body = serial.alloc_aligned(len(blob) - serial.HEADER.size)
+    body[:] = np.frombuffer(blob, np.uint8)[serial.HEADER.size:]
+    _r, _f, view = serial.deserialize_body(memoryview(body))
+    _r, _f, copy = serial.deserialize_body(memoryview(body),
+                                           copy_tensors=True)
+    assert np.shares_memory(view["x"], body)
+    assert not np.shares_memory(copy["x"], body)
+    np.testing.assert_array_equal(view["x"], copy["x"])

@@ -55,7 +55,7 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/perf.py \
   --suite cpu-proxy --smoke --trends bench/trends.jsonl
 
 echo "== chaos + serving smoke =="
-# Bounded seeded fault-injection pass (11 scenarios, well under 60s,
+# Bounded seeded fault-injection pass (12 scenarios, well under 60s,
 # CPU-only): loss storm, partition+heal, leader loss, the survivable-
 # training trio (learner SIGKILL + same-name restart rejoin with loss
 # continuity; broker kill + standby promotion adopting the epoch from
@@ -73,11 +73,23 @@ echo "== chaos + serving smoke =="
 # the seed + replay command (long-run version: chaos_soak.py
 # --minutes; --scenario GLOB selects a subset; per-scenario wall time
 # rides the JSON report).
+# The pass also covers the same-host shm transport lane:
+# shm_lane_fallback (segment death mid-call -> exactly-once TCP
+# fallback, /dev/shm unlink, deterministic event log) rides the
+# scenario list, so the ring's lock discipline runs under locktrace
+# like everything else.
 # --locktrace additionally runs the whole pass under instrumented locks
 # (testing/locktrace.py): the OBSERVED acquires-while-holding graph must
 # stay acyclic (no lock-order inversion ever executed) and inside
 # racelint's static over-approximation (docs/analysis.md).
 env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke --locktrace
+
+# shm transport interop tests (same-host selection, cross-host refusal,
+# MOOLIB_TPU_SHM=0 interop, /dev/shm leak hygiene, zero-copy receive):
+# run as their own step in this stage so a lane regression is named
+# here, minutes before the full tier-1 sweep would catch it.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_shmring.py -q -p no:cacheprovider
 
 echo "== incident smoke =="
 # flightrec end-to-end (docs/incidents.md): an in-process cohort under a

@@ -34,6 +34,8 @@ MODULES = [
      "transports, dynamic batching"),
     ("moolib_tpu.rpc.serial", "binary wire serialization, zero-copy tensor "
      "framing"),
+    ("moolib_tpu.rpc.shmring", "same-host shared-memory ring transport: "
+     "SPSC rings, spill slots, pipe doorbells"),
     ("moolib_tpu.rpc.broker", "cohort membership authority"),
     ("moolib_tpu.rpc.group", "group membership view + DCN tree allreduce"),
     ("moolib_tpu.rpc.faults", "fault-injection hook contract for the RPC "
