@@ -53,6 +53,10 @@ _EXPORTS = {
     "FlightRecorder": "moolib_tpu.flightrec",
     "capture_incident": "moolib_tpu.flightrec",
     "enable_auto_capture": "moolib_tpu.flightrec",
+    # durable state (docs/reliability.md, "Durable state")
+    "StateStore": "moolib_tpu.statestore",
+    "Replicator": "moolib_tpu.statestore",
+    "StateStoreError": "moolib_tpu.statestore",
     # utils
     "set_log_level": "moolib_tpu.utils",
     "set_logging": "moolib_tpu.utils",

@@ -113,6 +113,12 @@ CPU_PROXY_BUDGETS: Dict[str, Budget] = {
     # multiple GB/s measured.
     "serial_encode_gbps": Budget(value_min=0.1),
     "serial_decode_gbps": Budget(value_min=0.1),
+    # Durable-state publish pipeline: pickle + sha256 + fsync'd staging
+    # writes + loopback offer/ingest/commit push — hashing and disk
+    # bound, well under the raw serial rows; the floor catches a wedged
+    # replication path (a stalled bulk window, a commit that re-verifies
+    # the world), not a slow disk.
+    "statestore_replicate_gbps": Budget(value_min=0.005),
     # Serving closed loop (router + 2 replicas, 8 concurrent callers,
     # batched jitted model): hundreds of req/s and ~tens-of-ms p99
     # measured at smoke sizes — the floor/ceilings catch a wedged batch

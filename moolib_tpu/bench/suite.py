@@ -32,6 +32,11 @@ benchmark                       hot path it guards
                                 worker -> first post-respawn step
 ``serial_encode_gbps`` /        wire serialization of tensor payloads —
 ``serial_decode_gbps``          under every RPC byte
+``statestore_replicate_gbps``   durable-state publish pipeline (encode,
+                                chunk + sha256, crash-atomic local write,
+                                offer/ingest/commit push to one loopback
+                                replica) — the rate at which a committed
+                                model version becomes peer-durable
 ``serving_qps`` /               serving-tier closed loop (router dispatch,
 ``serving_p99_latency_s``       admission, dynamic batching in jit) —
                                 throughput and the tail the robustness
@@ -91,6 +96,9 @@ TREND_TOLERANCE = {
     "envpool_recovery_s": 0.65,
     "serial_encode_gbps": 0.65,
     "serial_decode_gbps": 0.65,
+    # Pickle + sha256 + fsync'd disk writes + RPC push: every noise
+    # source the serial and rpc rows see, plus the disk.
+    "statestore_replicate_gbps": 0.65,
     # Serving tier: a threaded closed-loop through router + 2 replicas —
     # every scheduling noise source above compounds here, and p99 is a
     # tail statistic on top of it (observed swinging ~2x run-to-run on
@@ -577,6 +585,53 @@ def bench_serial_decode(smoke: bool) -> BenchResult:
     )
 
 
+# -- durable state (statestore) -----------------------------------------------
+
+
+def bench_statestore_replicate(smoke: bool) -> BenchResult:
+    """Durable-state publish throughput: one committed model version
+    through the full replication pipeline — encode, chunk + per-chunk
+    sha256, crash-atomic local write (fsync'd staging + rename), then
+    the offer/ingest/commit push to one loopback replica. GB/s of state
+    made peer-durable; the CPU proxy under the ``ss_publish`` ->
+    ``ss_replicate`` path the host-loss scenario depends on."""
+    import tempfile
+
+    from ..statestore import StateStore
+
+    nbytes = (4 << 20) if smoke else (16 << 20)
+    repeats = 4 if smoke else 8
+    state = {"w": np.ones(nbytes // 4, np.float32)}
+    a, b = _echo_cohort()
+    version = [0]
+    with tempfile.TemporaryDirectory() as td:
+        store_a = StateStore(td + "/a", a, keep_versions=2, name="bench-a")
+        store_b = StateStore(td + "/b", b, keep_versions=2, name="bench-b")
+        try:
+
+            def rep():
+                version[0] += 1
+                acks = store_a.publish(version[0], state,
+                                       peers=("perfwatch-server",))
+                if not all(acks.values()):
+                    raise RuntimeError(f"publish not fully acked: {acks}")
+
+            samples = measure(rep, warmup=1, repeats=repeats)
+            stats = trimmed_stats(samples)
+            gbps = nbytes / stats["median"] / 1e9
+            return _result(
+                "statestore_replicate_gbps", gbps, "GB/s", "higher",
+                smoke, stats=stats, telemetry=a.telemetry.snapshot(),
+                extra={"payload_mb": round(nbytes / 1e6, 1),
+                       "versions": version[0]},
+            )
+        finally:
+            store_a.close()
+            store_b.close()
+            a.close()
+            b.close()
+
+
 # -- serving tier -------------------------------------------------------------
 
 #: One serving load run feeds BOTH serving rows (the cohort costs ~2s to
@@ -728,6 +783,7 @@ CPU_PROXY_SUITE: Dict[str, Callable[[bool], BenchResult]] = {
     "envpool_recovery_s": bench_envpool_recovery,
     "serial_encode_gbps": bench_serial_encode,
     "serial_decode_gbps": bench_serial_decode,
+    "statestore_replicate_gbps": bench_statestore_replicate,
     "serving_qps": bench_serving_qps,
     "serving_p99_latency_s": bench_serving_p99,
 }

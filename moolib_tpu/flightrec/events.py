@@ -53,6 +53,12 @@ KINDS: Dict[str, Tuple[str, ...]] = {
     "worker_respawn": ("pool", "slot"),
     "worker_down": ("pool", "slot", "strikes"),
     "env_quarantine": ("pool", "env", "why"),
+    # Durable-state tier (moolib_tpu/statestore/)
+    "ss_publish": ("store", "version", "chunks", "bytes"),
+    "ss_replicate": ("store", "version", "peer", "ok"),
+    "ss_write_failure": ("store", "version", "op", "error"),
+    "ss_restore": ("store", "version", "holders", "refetched"),
+    "ss_gc": ("store", "version"),
     # chaosnet injections (moolib_tpu/testing/chaos.py) and the incident
     # machinery itself (moolib_tpu/flightrec/capture.py)
     "chaos": ("kind", "action", "peer", "endpoint"),

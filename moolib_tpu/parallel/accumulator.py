@@ -368,6 +368,8 @@ class Accumulator:
         self._results: deque = deque()
         self._result_version = 0  # model version the latest result produces
         self._user_has_contributed = False
+        # Durability seam (see set_durability_hook).
+        self._durability_hook: Optional[Callable[[int], None]] = None
 
         # Telemetry (per-Rpc registry): cumulative round/election counters
         # live HERE — get_gradient_stats() is a thin view over them plus
@@ -456,6 +458,17 @@ class Accumulator:
         with self._lock:
             self._model_version = int(v)
             self._result_version = int(v)
+
+    def set_durability_hook(self, fn: Optional[Callable[[int], None]]):
+        """Install (or clear, with None) the durability hook: called with
+        each newly applied model version at ``zero_gradients`` time —
+        when the caller's params embody that version — outside the lock.
+        The statestore's :class:`~moolib_tpu.statestore.Replicator` uses
+        it to stream committed versions to replica peers without ever
+        stalling a gradient round; the hook itself must be cheap (note
+        and return)."""
+        with self._lock:
+            self._durability_hook = fn
 
     def is_leader(self) -> bool:
         # Under the (reentrant) lock: election writes _leader on RPC
@@ -577,11 +590,28 @@ class Accumulator:
 
     def zero_gradients(self):
         """Consume the oldest reduced result; re-enables wants_gradients."""
+        hook = None
+        version = None
         with self._lock:
             if self._results:
                 _mean, _count, version = self._results.popleft()
                 self._result_version = version
+                hook = self._durability_hook
             self._user_has_contributed = False
+        if hook is not None and version is not None:
+            # The durability seam (moolib_tpu.statestore.Replicator):
+            # at THIS instant the caller's params embody `version` (the
+            # contract is apply-then-zero), so it is the one moment a
+            # (version, state) pair can be snapshotted untorn. The hook
+            # must only *note* the version (the replicator's worker does
+            # the slow work) — and it runs outside the lock either way.
+            try:
+                hook(version)
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # never swallow task cancellation
+            except Exception as e:  # durability must not break training
+                log.error("durability hook failed for v%d: %s", version, e)
 
     # -- heartbeat ------------------------------------------------------------
 

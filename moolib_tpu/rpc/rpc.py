@@ -1148,6 +1148,18 @@ class Rpc:
             self._drop_conn(conn, f"write failed: {e}")
             raise
 
+    def _write_detached(self, conn: _Conn, frames: List[Any]):
+        """Fire-and-forget ``_write`` — LOOP THREAD ONLY. For replies,
+        acks and control messages whose loss is covered by another
+        mechanism (poke/resend, re-offer): ``_write``'s own failure path
+        already tears the connection down (``_drop_conn``), and its
+        re-raise exists for *awaiting* callers — route through
+        ``_write_quiet`` so a send racing a closing connection cannot
+        spam the event loop's 'Task exception was never retrieved'
+        reporter (cancellation still propagates: a cancelled task is
+        not an unretrieved exception)."""
+        self._loop.create_task(self._write_quiet(conn, frames))
+
     def _write_now(self, conn: _Conn, frames: List[Any]) -> bool:
         """Synchronous fast-path write — LOOP THREAD ONLY.
 
@@ -1425,8 +1437,8 @@ class Rpc:
             found = list(self._listen_addrs)
         if found:
             payload = {"name": name, "addresses": found}
-            self._loop.create_task(
-                self._write(conn, serial.serialize(0, FID_PEER_FOUND, payload))
+            self._write_detached(
+                conn, serial.serialize(0, FID_PEER_FOUND, payload)
             )
 
     def _on_peer_found(self, obj):
@@ -1504,8 +1516,8 @@ class Rpc:
         }
         payload = lane.offer_payload()
         payload["boot_id"] = self._boot_id
-        self._loop.create_task(
-            self._write(conn, serial.serialize(0, FID_SHM_OFFER, payload))
+        self._write_detached(
+            conn, serial.serialize(0, FID_SHM_OFFER, payload)
         )
 
     def _on_shm_offer(self, conn: _Conn, obj):
@@ -1535,11 +1547,9 @@ class Rpc:
                 why = f"attach failed: {type(e).__name__}: {e}"
                 log.debug("%s: refusing shm offer from %s: %s",
                           self._name, conn.peer_name, why)
-        self._loop.create_task(
-            self._write(conn, serial.serialize(
-                0, FID_SHM_ACCEPT, {"ok": ok, "why": why}
-            ))
-        )
+        self._write_detached(conn, serial.serialize(
+            0, FID_SHM_ACCEPT, {"ok": ok, "why": why}
+        ))
 
     def _on_shm_accept(self, conn: _Conn, obj):
         """Creator side: the attacher's verdict. ok -> mount our half;
@@ -1654,7 +1664,7 @@ class Rpc:
         if key in self._recent_rids:
             cached = self._response_cache.get(key)
             if cached is not None:
-                self._loop.create_task(self._write(conn, cached))
+                self._write_detached(conn, cached)
             return  # duplicate (resend after reconnect): suppress re-execution
         self._mark_recent(key)
         entry = self._functions.get(fid)
@@ -1815,7 +1825,7 @@ class Rpc:
                 )
             else:
                 frames = serial.serialize(rid, FID_ACK, None)
-        self._loop.create_task(self._write(conn, frames))
+        self._write_detached(conn, frames)
 
     def _on_response(self, conn: _Conn, rid: int, fid: int, obj):
         out = self._outgoing.pop(rid, None)
@@ -2104,6 +2114,40 @@ class Rpc:
         return self.async_(peer, func, *args, **kwargs).result(
             self._timeout + 30.0
         )
+
+    def bulk(self, calls, *, window: int = 8,
+             timeout: Optional[float] = None):
+        """Bounded-window bulk fetch: issue ``calls`` — an iterable of
+        ``(peer, func, args_tuple)`` — keeping at most ``window`` in
+        flight, and return ``[(result, error), ...]`` in call order.
+        Per-call failures (RpcError/TimeoutError) are captured in the
+        pair, never raised, so one dead holder costs one entry — the
+        statestore's chunk-pull/push primitive, where the caller retries
+        failed items against a different peer. Cancellation always
+        propagates."""
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window!r}")
+        per_call = self._timeout if timeout is None else float(timeout)
+        calls = list(calls)
+        results: List[Any] = [None] * len(calls)
+        inflight: "deque[Tuple[int, Future]]" = deque()
+
+        def settle(idx: int, fut: Future):
+            try:
+                results[idx] = (fut.result(timeout=per_call + 30.0), None)
+            except (asyncio.CancelledError,
+                    concurrent.futures.CancelledError):
+                raise  # never swallow task cancellation
+            except (RpcError, TimeoutError) as e:
+                results[idx] = (None, e)
+
+        for i, (peer, func, args) in enumerate(calls):
+            inflight.append((i, self.async_(peer, func, *args)))
+            if len(inflight) >= window:
+                settle(*inflight.popleft())
+        while inflight:
+            settle(*inflight.popleft())
+        return results
 
     async def _write_quiet(self, conn: _Conn, frames: List[Any]):
         """Awaitable write that swallows connection failures — for replies

@@ -36,7 +36,8 @@ from .admission import (
 )
 from .health import CircuitBreaker, ReplicaHealth
 from .replica import ENDPOINT_SUFFIXES, Replica
-from .router import Router, publish_from_accumulator
+from .router import (Router, publish_from_accumulator,
+                     publish_from_statestore)
 
 __all__ = [
     "AdmissionQueue",
@@ -50,4 +51,5 @@ __all__ = [
     "ServingError",
     "error_kind",
     "publish_from_accumulator",
+    "publish_from_statestore",
 ]

@@ -6,11 +6,13 @@ never pays for (or accidentally enables) chaos machinery; see
 :mod:`moolib_tpu.testing.chaos` and :mod:`moolib_tpu.testing.locktrace`.
 """
 
-from .chaos import ChaosNet, Event, FaultPlan, ProcChaos, ProcFaultPlan
+from .chaos import (ChaosNet, Event, FaultPlan, ProcChaos, ProcFaultPlan,
+                    ResourceChaos, ResourceFaultPlan)
 from .locktrace import LockOrderViolation, LockTrace
 
 __all__ = ["ChaosNet", "Event", "FaultPlan", "LockOrderViolation",
-           "LockTrace", "ProcChaos", "ProcFaultPlan", "SCENARIOS"]
+           "LockTrace", "ProcChaos", "ProcFaultPlan", "ResourceChaos",
+           "ResourceFaultPlan", "SCENARIOS"]
 
 
 def __getattr__(name):

@@ -69,7 +69,8 @@ from ..utils import get_logger
 log = get_logger("chaos")
 
 __all__ = ["Event", "FaultPlan", "ChaosNet", "CONTROL_NAMES",
-           "ProcFaultPlan", "ProcChaos"]
+           "ProcFaultPlan", "ProcChaos", "ResourceFaultPlan",
+           "ResourceChaos"]
 
 #: Control-plane fids get stable ``@``-prefixed endpoint names so rules can
 #: target them by pattern (e.g. ``blackhole_keepalive`` drops "@keepalive").
@@ -341,7 +342,13 @@ class FaultPlan:
                 f"chaos {kind}", "chaos", pid=me or "chaos",
                 args={"action": str(action), "peer": peer,
                       "endpoint": endpoint, "rid": rid,
-                      "arg": None if arg is None else float(arg)},
+                      # Wire rules log numeric args (delay seconds, copy
+                      # counts); disk rules log the destination basename
+                      # — a string must not blow up the tracing branch.
+                      "arg": (None if arg is None
+                              else float(arg)
+                              if isinstance(arg, (int, float)) else
+                              str(arg))},
             )
 
     def observe(self, kind: str, me: str, peer: Optional[str], detail: str):
@@ -482,6 +489,166 @@ class ProcChaos:
         import signal as _signal
 
         self._apply(slot, _signal.SIGUSR1, "proc_raise", "raise")
+
+
+class _DiskRule:
+    """One resource-exhaustion rule: inject ``errno_code`` when a disk
+    operation matching (op glob, path glob) occurs, with the same
+    after/count bounding discipline as the wire rules."""
+
+    __slots__ = ("kind", "errno_code", "op", "path", "after", "count",
+                 "matched", "fired")
+
+    def __init__(self, kind: str, errno_code: int, op: str, path: str,
+                 after: int, count: Optional[int]):
+        self.kind = kind
+        self.errno_code = errno_code
+        self.op = op
+        self.path = path
+        self.after = int(after)
+        self.count = count
+        self.matched = 0
+        self.fired = 0
+
+    def exhausted(self) -> bool:
+        return self.count is not None and self.fired >= self.count
+
+
+class ResourceFaultPlan(ProcFaultPlan):
+    """Seeded plan for RESOURCE-exhaustion faults — the chaos discipline
+    extended to the durability tier: injected ``ENOSPC`` (disk full) and
+    ``EMFILE`` (fd exhaustion) at the crash-atomic write seams
+    (:mod:`moolib_tpu.utils.diskio` — checkpoint and statestore writes
+    both flow through them). Decisions are pure in (seed, sequence of
+    disk operations presented); every injection lands in the same
+    replayable ordered event log (kinds ``enospc`` / ``emfile``, the
+    logged ``arg`` is the destination *basename* so staging-dir nonces
+    and tmpdirs cannot break replay identity) and mirrors into
+    ``chaos_injected_total{kind}`` like every other injected fault.
+
+    :class:`ResourceChaos` installs the plan on the process-wide diskio
+    hook; rules scope by path glob (match against the root-relative
+    destination path), so one member's store can fill while its peers'
+    disks stay healthy.
+
+    Also inherits :meth:`ProcFaultPlan.pick` — the seeded target draw
+    the bit-flip scenario uses to choose which replica/byte to corrupt.
+    """
+
+    def __init__(self, seed: int = 0, telemetry: Optional[Telemetry] = None):
+        super().__init__(seed, telemetry)
+        self._disk_rules: List[_DiskRule] = []
+
+    def enospc(self, path: str = "*", *, op: str = "write", after: int = 0,
+               count: Optional[int] = None) -> "ResourceFaultPlan":
+        """Inject ``OSError(ENOSPC)`` on matching writes/fsyncs — the
+        disk-full class. ``op`` globs over ``open``/``write``/``fsync``;
+        ``after`` skips the first N matching operations (land the
+        failure mid-bundle), ``count`` bounds total injections."""
+        import errno
+
+        return self._disk_rule("enospc", errno.ENOSPC, op, path, after,
+                               count)
+
+    def emfile(self, path: str = "*", *, op: str = "open", after: int = 0,
+               count: Optional[int] = None) -> "ResourceFaultPlan":
+        """Inject ``OSError(EMFILE)`` on matching opens — the
+        fd-exhaustion class."""
+        import errno
+
+        return self._disk_rule("emfile", errno.EMFILE, op, path, after,
+                               count)
+
+    def _disk_rule(self, kind, errno_code, op, path, after,
+                   count) -> "ResourceFaultPlan":
+        with self._lock:
+            self._disk_rules.append(
+                _DiskRule(kind, errno_code, op, path, after, count)
+            )
+        return self
+
+    def decide_disk(self, op: str, path: str) -> Optional[OSError]:
+        """Verdict for one disk operation — deterministic like
+        :meth:`FaultPlan.decide`: first non-exhausted rule whose op AND
+        path globs match (post-``after``) fires. Returns the OSError to
+        raise (tagged with ``statestore_op`` so the failure counters
+        label the seam) or None to pass."""
+        import os as _os
+
+        with self._lock:
+            for rule in self._disk_rules:
+                if rule.exhausted():
+                    continue
+                if not fnmatchcase(op, rule.op):
+                    continue
+                if not fnmatchcase(path, rule.path):
+                    continue
+                rule.matched += 1
+                if rule.matched <= rule.after:
+                    continue
+                rule.fired += 1
+                self._log_locked(rule.kind, "raise", None, None, op, None,
+                                 _os.path.basename(path))
+                e = OSError(rule.errno_code,
+                            f"injected {rule.kind} ({op} {path})")
+                e.statestore_op = op
+                return e
+        return None
+
+
+class ResourceChaos:
+    """Installs a :class:`ResourceFaultPlan` on the process-wide disk
+    fault hook (:mod:`moolib_tpu.utils.diskio`). ``root`` relativizes
+    the paths rules match against (operations outside ``root`` match
+    with their absolute path — so a rule's path glob can still pin one
+    store's directory while everything else passes untouched)::
+
+        plan = ResourceFaultPlan(seed).enospc("v*/c*.bin", after=1,
+                                              count=1)
+        with ResourceChaos(plan, root=store.root):
+            ...   # the second chunk write inside store.root fails
+    """
+
+    def __init__(self, plan: ResourceFaultPlan, root: Optional[str] = None):
+        import os as _os
+
+        self.plan = plan
+        self.root = None if root is None else _os.path.abspath(root)
+
+    def _hook(self, op: str, path: str) -> None:
+        import os as _os
+
+        p = _os.path.abspath(path)
+        if self.root is not None:
+            rel = _os.path.relpath(p, self.root)
+            if not rel.startswith(".."):
+                # Inside root: match the relative path, with staging-dir
+                # components rewritten to their FINAL version name
+                # (".stage-v000…42-<nonce>" -> "v000…42") so rules
+                # written against the committed layout ("v*/c*.bin")
+                # hit the staged write of that same file — and the
+                # nonce can never enter rule matching or the event log.
+                parts = []
+                for x in rel.split(_os.sep):
+                    if x.startswith(".stage-"):
+                        bits = x.split("-")
+                        x = bits[1] if len(bits) > 1 else x
+                    parts.append(x)
+                p = "/".join(parts)
+        err = self.plan.decide_disk(op, p)
+        if err is not None:
+            raise err
+
+    def __enter__(self) -> "ResourceChaos":
+        from ..utils import diskio
+
+        diskio.install_disk_fault_hook(self._hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ..utils import diskio
+
+        diskio.uninstall_disk_fault_hook()
 
 
 class _RpcFaultHooks:

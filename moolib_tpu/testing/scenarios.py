@@ -23,7 +23,8 @@ import numpy as np
 from ..rpc import Rpc, RpcError
 from ..rpc.broker import Broker
 from ..rpc.group import Group
-from .chaos import ChaosNet, FaultPlan, ProcChaos, ProcFaultPlan
+from .chaos import (ChaosNet, FaultPlan, ProcChaos, ProcFaultPlan,
+                    ResourceChaos, ResourceFaultPlan)
 
 __all__ = [
     "EnvFleet",
@@ -36,6 +37,9 @@ __all__ = [
     "scenario_broker_failover",
     "scenario_straggler_quorum",
     "scenario_shm_lane_fallback",
+    "scenario_statestore_host_loss",
+    "scenario_statestore_disk_full",
+    "scenario_statestore_bitflip",
     "scenario_replica_kill",
     "scenario_router_partition",
     "scenario_envpool_worker_kill",
@@ -823,6 +827,512 @@ def scenario_shm_lane_fallback(seed: int, calls: int = 6) -> Dict[str, int]:
         host.close()
 
 
+# -- durable state (statestore) ----------------------------------------------
+
+
+class StateCohort:
+    """MiniCluster + N Accumulator members, each with a
+    :class:`~moolib_tpu.statestore.StateStore` and a
+    :class:`~moolib_tpu.statestore.Replicator` attached to its
+    durability hook — the canonical cohort for the statestore chaos
+    scenarios. Training is the same seeded SGD-on-a-quadratic the
+    learner-restart scenario uses, so the loss trajectory is exactly
+    computable and any torn/stale restore shows up as a trajectory
+    miss."""
+
+    def __init__(self, seed: int, n: int = 3, *, followers: int = 2,
+                 chunk_bytes: int = 256, keep_versions: int = 64,
+                 tmpdir: "str | None" = None):
+        import tempfile
+
+        rng = np.random.RandomState(seed)
+        self.target = rng.uniform(-1.0, 1.0, size=(4,)).astype(np.float32)
+        self.lr = np.float32(0.2)
+        self.followers = followers
+        self.chunk_bytes = chunk_bytes
+        self.keep_versions = keep_versions
+        self.cluster = MiniCluster()
+        self.td = tempfile.TemporaryDirectory(dir=tmpdir)
+        self.state: Dict[str, np.ndarray] = {}
+        self.accs: Dict[str, Any] = {}
+        self.stores: Dict[str, Any] = {}
+        self.reps: Dict[str, Any] = {}
+        for i in range(n):
+            self.add_member(f"p{i}")
+
+    def root(self, name: str) -> str:
+        import os
+
+        return os.path.join(self.td.name, f"{name}-store")
+
+    def add_member(self, name: str, *, restore_from=(), quorum: int = 2):
+        """Spawn a member. With ``restore_from`` it first runs the
+        restore negotiation against those peers (the wiped-rejoiner
+        path) and seeds its model version from the restored bundle so a
+        durable-state holder competes in leader election like a
+        checkpoint holder would. Returns the restored version (or
+        None)."""
+        from ..parallel import Accumulator
+        from ..statestore import Replicator, StateStore
+
+        rpc, g = self.cluster.spawn(name)
+        store = StateStore(self.root(name), rpc,
+                           chunk_bytes=self.chunk_bytes,
+                           keep_versions=self.keep_versions, name=name)
+        self.state.setdefault(name, np.zeros(4, np.float32))
+        restored_version = None
+        if restore_from:
+            restored = store.restore(tuple(restore_from), quorum=quorum,
+                                     timeout=15.0)
+            assert restored is not None, (
+                f"{name}: restore negotiation with {restore_from} found "
+                "nothing restorable"
+            )
+            restored_version, s = restored
+            self.state[name] = np.asarray(s["w"], np.float32)
+
+        def get_state(n=name):
+            return {"w": self.state[n]}
+
+        def set_state(s, n=name):
+            self.state[n] = np.asarray(s["w"], np.float32)
+
+        acc = Accumulator(rpc, group=g, virtual_batch_size=2,
+                          get_state=get_state, set_state=set_state)
+        if restored_version is not None:
+            acc.set_model_version(restored_version)
+        rep = Replicator(store, acc,
+                         state_fn=lambda n=name: {"w": self.state[n]},
+                         followers=self.followers)
+        self.accs[name] = acc
+        self.stores[name] = store
+        self.reps[name] = rep
+        return restored_version
+
+    def traj(self, version: int) -> np.ndarray:
+        """The exact params every member holds after ``version``
+        applied updates (all members contribute the same gradient, so
+        the cohort walks one deterministic trajectory)."""
+        w = np.zeros(4, np.float32)
+        for _ in range(version):
+            g = np.asarray(2.0 * (w - self.target), np.float32)
+            mean = np.asarray((g + g + g) / 3, np.float32)
+            w = np.asarray(w - self.lr * mean, np.float32)
+        return w
+
+    def drive(self, until, timeout: float, what: str):
+        """Pump all live members through the apply/contribute loop."""
+        def step(a):
+            name = a.rpc.get_name()
+            if a.has_gradients():
+                mean, _count = a.result_gradients()
+                self.state[name] = np.asarray(
+                    self.state[name] - self.lr * mean["w"], np.float32
+                )
+                a.zero_gradients()  # fires the durability hook
+            elif a.wants_gradients():
+                a.reduce_gradients(
+                    {"w": 2.0 * (self.state[name] - self.target)},
+                    batch_size=1,
+                )
+
+        _pump_accs(list(self.accs.values()), until, timeout, what,
+                   each=step)
+
+    def kill_member(self, name: str, net, *, wipe: bool = False):
+        """SIGKILL-equivalent death; with ``wipe`` the member's store
+        directory dies with the host (the host-loss failure class)."""
+        import shutil
+
+        acc = self.accs.pop(name)
+        self.reps.pop(name).close()
+        store = self.stores.pop(name)
+        net.kill_conns(acc.rpc)
+        acc.rpc.close()
+        store.close()
+        if wipe:
+            shutil.rmtree(self.root(name), ignore_errors=True)
+        return acc
+
+    def replicated_on(self, holders, v_min: int = 1):
+        """Newest version advertised with one hash by ALL ``holders``
+        (>= ``v_min``), or None."""
+        ads = [dict(self.stores[h].versions()) for h in holders]
+        common = [v for v in ads[0]
+                  if all(v in a and a[v] == ads[0][v] for a in ads[1:])]
+        newest = max(common, default=None)
+        return newest if newest is not None and newest >= v_min else None
+
+    def close(self):
+        for rep in self.reps.values():
+            rep.close()
+        for store in self.stores.values():
+            store.close()
+        self.cluster.close()
+        self.td.cleanup()
+
+
+def scenario_statestore_host_loss(seed: int, rounds: int = 12,
+                                  tmpdir: "str | None" = None
+                                  ) -> Dict[str, int]:
+    """Host loss: SIGKILL a member AND wipe its checkpoint/statestore
+    directory — the one failure PR 11's local-checkpoint restart cannot
+    survive. The leader's Replicator has been streaming committed
+    versions to follower replicas (asynchronously, off the training
+    thread), so the same-name restart with an EMPTY disk runs the
+    restore negotiation, agrees with the survivors on the newest
+    quorum-verified version, pulls its chunks from a peer replica, and
+    rejoins — and its loss trajectory matches the undisturbed control
+    run (the restored state *is* a point on the exact deterministic
+    trajectory, and resync brings it to the survivors' current step).
+    The whole sequence — publish, replicate, conn kill, restore — is
+    visible in ONE merged flightrec timeline across all members
+    including the dead one's black box. The only injection is the
+    scripted conn kill, so the event log is identical for identical
+    seeds."""
+    from ..flightrec.bundle import snapshot_bundle
+    from ..flightrec.merge import merge_bundles
+
+    cohort = StateCohort(seed, 3, followers=2, tmpdir=tmpdir)
+    plan = FaultPlan(seed)
+    net = None
+    victim_telemetry = None
+    try:
+        net = ChaosNet(plan, [a.rpc for a in cohort.accs.values()]
+                       + [cohort.cluster.broker_rpc])
+        kill_at = max(2, rounds // 3)
+        # Train until the version is durable on BOTH survivors-to-be:
+        # quorum-2 negotiation after the wipe needs two agreeing
+        # holders (the victim's own replica dies with its disk).
+        cohort.drive(
+            lambda: all(a.model_version >= kill_at
+                        for a in cohort.accs.values())
+            and cohort.replicated_on(["p0", "p1"], 1) is not None,
+            40, "pre-kill training + replication",
+        )
+        bar = float(((cohort.traj(rounds) - cohort.target) ** 2).mean())
+
+        victim_telemetry = cohort.accs["p2"].rpc.telemetry
+        cohort.kill_member("p2", net, wipe=True)
+        import os
+
+        assert not os.path.exists(cohort.root("p2")), "wipe failed"
+
+        # Same-name restart from NOTHING but the peer replicas.
+        restored_v = cohort.add_member("p2", restore_from=("p0", "p1"),
+                                       quorum=2)
+        assert restored_v is not None and restored_v >= 1
+        # Integrity: the pulled params are byte-identical to the copy
+        # the surviving replica holds for that version (per-chunk
+        # sha256 against the quorum-agreed manifest makes this exact,
+        # not approximate).
+        np.testing.assert_array_equal(
+            cohort.state["p2"],
+            np.asarray(cohort.stores["p0"].load(restored_v)["w"],
+                       np.float32),
+            err_msg=f"restored v{restored_v} differs from the replica's "
+                    "copy",
+        )
+
+        cohort.drive(
+            lambda: all(
+                a.connected() and a._synced
+                and len(a.group.members) == 3
+                for a in cohort.accs.values()
+            ), 30, "restart rejoin",
+        )
+        cohort.drive(
+            lambda: all(a.model_version >= rounds
+                        for a in cohort.accs.values())
+            and all(not a.has_gradients() for a in cohort.accs.values()),
+            30, "post-restore training",
+        )
+        # Loss continuity vs the undisturbed control run.
+        for name, a in cohort.accs.items():
+            w = cohort.state[name]
+            loss = float(((w - cohort.target) ** 2).mean())
+            assert loss <= bar * 1.05 + 1e-7, (
+                f"{name} missed the control loss bar: {loss} > {bar}"
+            )
+        ws = list(cohort.state[n] for n in cohort.accs)
+        for w in ws[1:]:
+            np.testing.assert_allclose(w, ws[0], rtol=1e-5, atol=1e-6)
+
+        # ONE merged flightrec timeline shows the whole sequence — the
+        # dead member's black box included (post-mortem snapshot).
+        bundles = {
+            name: snapshot_bundle(a.rpc.telemetry)
+            for name, a in cohort.accs.items()
+        }
+        bundles["p2-dead"] = snapshot_bundle(victim_telemetry)
+        timeline, _meta = merge_bundles(bundles)
+        kinds = [r.get("kind") for r in timeline if r["type"] == "event"]
+        for want in ("ss_publish", "ss_replicate", "ss_restore", "chaos"):
+            assert want in kinds, (
+                f"{want} missing from the merged timeline: "
+                f"{sorted(set(kinds))}"
+            )
+        restores = [r for r in timeline if r["type"] == "event"
+                    and r.get("kind") == "ss_restore"]
+        kill_marks = [
+            i for i, r in enumerate(timeline)
+            if r["type"] == "event" and r.get("kind") == "chaos"
+            and r["fields"].get("kind") == "conn_kill"
+        ]
+        assert restores and kill_marks, (restores, kill_marks)
+        assert restores[-1]["fields"]["version"] == restored_v
+        assert timeline.index(restores[-1]) > kill_marks[0], (
+            "the restore must appear after the kill on the merged "
+            "timeline"
+        )
+
+        assert [e.kind for e in plan.events] == ["conn_kill"], (
+            f"unexpected injected-event log: {plan.events}"
+        )
+        plan.verify_telemetry()  # registry counters == injected log
+        return plan.summary()
+    finally:
+        if net is not None:
+            net.detach_all()
+        cohort.close()
+
+
+def scenario_statestore_disk_full(seed: int,
+                                  tmpdir: "str | None" = None
+                                  ) -> Dict[str, int]:
+    """Disk full mid-checkpoint on the leader: an injected ENOSPC lands
+    in the middle of a bundle write (first chunk succeeds, manifest
+    fails). The failure is TYPED, counted
+    (``statestore_write_failures_total``) and flight-recorded
+    (``ss_write_failure``); crash-atomic staging leaves no torn or
+    half-GC'd bundle (strict re-validation of every surviving version
+    passes and no staging leftovers remain); the cohort KEEPS TRAINING;
+    and the durability role moves — the degraded leader widens its
+    follower set, so new versions become durable on replicas its own
+    disk never held. ENOSPC fire counts are cadence-dependent (like the
+    straggler scenario's delays), so this asserts invariants plus
+    decision-level telemetry consistency rather than an exact log."""
+    import os
+
+    cohort = StateCohort(seed, 3, followers=1, tmpdir=tmpdir)
+    rplan = ResourceFaultPlan(seed)
+    try:
+        # Leadership is an election outcome, not a constant: startup
+        # churn (a member joining the broker late) can crown any name.
+        # Derive the leader and its sorted-ring followers (the
+        # Replicator's deterministic placement) once a leader's version
+        # has actually replicated to its first follower.
+        def ring_after(name):
+            names = sorted(cohort.accs)
+            i = names.index(name)
+            return names[i + 1:] + names[:i]
+
+        def sole_leader():
+            leaders = [n for n, a in cohort.accs.items()
+                       if a.is_leader()]
+            return leaders[0] if len(leaders) == 1 else None
+
+        def baseline_replicated():
+            ln = sole_leader()
+            return (ln is not None
+                    and cohort.replicated_on([ln, ring_after(ln)[0]], 1)
+                    is not None)
+
+        cohort.drive(baseline_replicated, 40,
+                     "baseline replication (leader + 1 follower)")
+        leader_name = sole_leader()
+        f1, f2 = ring_after(leader_name)
+        leader = cohort.accs[leader_name]
+        store = cohort.stores[leader_name]
+        baseline = store.latest()
+        assert baseline is not None
+        if max(a.get_gradient_stats()["elections"]
+               for a in cohort.accs.values()) == 1:
+            # No leadership churn: with followers=1 the second ring
+            # follower must hold nothing until the durability role
+            # moves. (A transient earlier leader may legitimately have
+            # pushed a version elsewhere, so the assert is scoped to
+            # the churn-free common case.)
+            assert not dict(cohort.stores[f2].versions()), (
+                "with followers=1 the second follower must hold "
+                "nothing until the durability role moves"
+            )
+        v_before = leader.model_version
+
+        # Disk fills mid-bundle: the first staged write of each bundle
+        # succeeds, everything after fails — and stays failing until
+        # the chaos context exits (a full disk does not heal itself).
+        rplan.enospc("v*/*", op="write", after=1)
+        reg = leader.rpc.telemetry.registry
+        with ResourceChaos(rplan, root=store.root):
+            cohort.drive(
+                lambda: store.degraded
+                and (reg.value("statestore_write_failures_total",
+                               op="write") or 0) >= 1
+                and cohort.replicated_on([f1, f2], baseline + 1)
+                is not None,
+                40, "degraded leader hands durability to both followers",
+            )
+            # The cohort kept training THROUGH the full disk.
+            assert leader.model_version >= v_before + 1
+            handed = cohort.replicated_on([f1, f2], baseline + 1)
+
+        # Typed + flight-recorded: the black box names the seam.
+        ev = [e for e in leader.rpc.telemetry.flight.events()
+              if e["kind"] == "ss_write_failure"]
+        assert ev and ev[-1]["fields"]["op"] == "write", ev
+        # The replicator's ack map records the failed local write the
+        # way a caller of put() would see it typed (WriteFailed).
+        from ..statestore import LOCAL, Replicator
+
+        # Quiesce the leader's replicator before auditing its disk: the
+        # worker may have a (now healthy) publish mid-stage, and a live
+        # ``.stage-*`` dir or a fresh post-chaos commit is normal
+        # operation, not a torn-bundle leak. close() joins the worker,
+        # so after it the directory is still.
+        rep = cohort.reps[leader_name]
+        rep.close()
+        failed_acks = [v for v, acks in rep.published.items()
+                       if acks.get(LOCAL) is False]
+        assert failed_acks, "no publish recorded the local write failure"
+
+        # No torn bundle, no half-GC: every surviving version on the
+        # leader's disk re-validates strictly, nothing but committed
+        # version dirs remains, and nothing from a FAILED write landed
+        # locally (an injected-window bundle either committed completely
+        # before its version failed — impossible, versions are immutable
+        # — or left no trace).
+        survivors = store.verify_all()
+        assert survivors, "leader lost its pre-fault versions"
+        assert not set(survivors) & set(failed_acks), (
+            survivors, failed_acks,
+        )
+        stray = [n for n in os.listdir(store.root)
+                 if not (n.startswith("v") and n[1:].isdigit())]
+        assert not stray, f"staging/GC leftovers after ENOSPC: {stray}"
+        # ... while the handed-off version IS durable on both followers.
+        assert handed is not None and handed > baseline
+        assert cohort.stores[f2].latest() is not None
+
+        # Disk freed: re-attach a replicator (the quiesce above was
+        # test-side); the next local write succeeds and clears degraded.
+        cohort.reps[leader_name] = Replicator(
+            store, leader,
+            state_fn=lambda: {"w": cohort.state[leader_name]},
+            followers=1,
+        )
+        recovered_from = store.latest() or 0
+        cohort.drive(
+            lambda: not store.degraded
+            and (store.latest() or 0) > recovered_from,
+            30, "store recovers once the disk frees",
+        )
+
+        kinds = {e.kind for e in rplan.events}
+        assert kinds == {"enospc"}, kinds
+        rplan.verify_telemetry()  # registry counters == injected log
+        return rplan.summary()
+    finally:
+        cohort.close()
+
+
+def scenario_statestore_bitflip(seed: int,
+                                tmpdir: "str | None" = None
+                                ) -> Dict[str, int]:
+    """A bit flips on one replica's disk AFTER it verified (and
+    advertised) a version: restore negotiation still agrees on the
+    version (both holders advertise the same manifest hash), the puller
+    detects the corrupt chunk by its sha256, counts the reject, and
+    refetches that chunk from the other holder — the restore succeeds
+    and the rejoiner becomes a verified holder itself. The corruption
+    target (holder + chunk + byte) is drawn from the seed, so the run
+    is replay-identical; no wire faults are injected (empty event
+    log)."""
+    import os
+    import tempfile
+
+    from ..statestore import StateStore
+    from ..statestore.bundle import read_manifest
+
+    plan = ResourceFaultPlan(seed)
+    rng = np.random.RandomState(seed)
+    state = {"w": rng.uniform(-1.0, 1.0, size=(256,)).astype(np.float64)}
+    a = Rpc(f"ssa{seed}")
+    b = Rpc(f"ssb{seed}")
+    c = Rpc(f"ssc{seed}")
+    with tempfile.TemporaryDirectory(dir=tmpdir) as td:
+        store_a = store_b = store_c = None
+        try:
+            a.listen("127.0.0.1:0")
+            b.listen("127.0.0.1:0")
+            store_a = StateStore(os.path.join(td, "a"), a, chunk_bytes=256,
+                                 name="ssa")
+            store_b = StateStore(os.path.join(td, "b"), b, chunk_bytes=256,
+                                 name="ssb")
+            a.connect(b.debug_info()["listen"][0])
+            acks = store_a.publish(7, state, peers=(b.get_name(),))
+            assert acks == {"<local>": True, b.get_name(): True}, acks
+            # Both holders verify + advertise (the verification cache is
+            # what makes post-verification rot the interesting case).
+            assert len(store_a.versions()) == 1
+            assert store_a.versions() == store_b.versions()
+
+            n_chunks = len(read_manifest(store_a.root, 7)["chunks"])
+            assert n_chunks >= 3, f"need a multi-chunk bundle: {n_chunks}"
+            # Seeded corruption target. The puller assigns chunk i of
+            # pass 0 to holders[i % 2] with holders ordered (ssa, ssb),
+            # so corrupting chunk k on THAT holder guarantees the first
+            # fetch hits the bad copy and the refetch path runs.
+            k = plan.pick(n_chunks)
+            corrupt_store = store_a if k % 2 == 0 else store_b
+            path = os.path.join(corrupt_store.root, f"v{7:012d}",
+                                f"c{k:06d}.bin")
+            size = os.path.getsize(path)
+            off = plan.pick(size)
+            with open(path, "r+b") as f:
+                f.seek(off)
+                byte = f.read(1)
+                f.seek(off)
+                f.write(bytes([byte[0] ^ 0x40]))
+
+            c.connect(a.debug_info()["listen"][0])
+            c.connect(b.debug_info()["listen"][0])
+            store_c = StateStore(os.path.join(td, "c"), c, chunk_bytes=256,
+                                 name="ssc")
+            restored = store_c.restore((a.get_name(), b.get_name()),
+                                       quorum=2)
+            assert restored is not None
+            v, s = restored
+            assert v == 7
+            np.testing.assert_array_equal(s["w"], state["w"])
+
+            creg = c.telemetry.registry
+            assert creg.value("statestore_chunk_rejects_total") == 1, (
+                "exactly one chunk must be hash-rejected"
+            )
+            assert creg.value("statestore_restore_total") == 1
+            ev = [e for e in c.telemetry.flight.events()
+                  if e["kind"] == "ss_restore"]
+            assert ev and ev[-1]["fields"]["refetched"] == 1, ev
+            # The rejoiner persisted what it pulled: it is a holder now.
+            assert dict(store_c.versions()) == dict(store_b.versions())
+
+            # Replay determinism: no injected faults, and the seeded
+            # corruption target re-draws identically.
+            assert plan.events == [], plan.events
+            replay = ResourceFaultPlan(seed)
+            assert (replay.pick(n_chunks), replay.pick(size)) == (k, off)
+            plan.verify_telemetry()  # trivially: nothing injected
+            return plan.summary()
+        finally:
+            for st in (store_a, store_b, store_c):
+                if st is not None:
+                    st.close()
+            a.close()
+            b.close()
+            c.close()
+
+
 # -- serving tier ------------------------------------------------------------
 
 
@@ -1484,6 +1994,9 @@ SCENARIOS = {
     "broker_failover": scenario_broker_failover,
     "straggler_quorum": scenario_straggler_quorum,
     "shm_lane_fallback": scenario_shm_lane_fallback,
+    "statestore_host_loss": scenario_statestore_host_loss,
+    "statestore_disk_full": scenario_statestore_disk_full,
+    "statestore_bitflip": scenario_statestore_bitflip,
     "replica_kill": scenario_replica_kill,
     "router_partition": scenario_router_partition,
     "envpool_worker_kill": scenario_envpool_worker_kill,

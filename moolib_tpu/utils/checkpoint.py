@@ -20,10 +20,10 @@ import concurrent.futures
 import glob
 import os
 import pickle
-import tempfile
 import time
 from typing import Any, List, Optional
 
+from . import diskio
 from .logging import get_logger
 
 log = get_logger("checkpoint")
@@ -56,30 +56,16 @@ def _to_host(tree: Any) -> Any:
 
 
 def save_checkpoint(path: str, state: Any) -> None:
-    """Atomically write ``state`` (any picklable pytree; jax arrays are
-    device_get'd) to ``path`` via tmp + ``os.replace``."""
+    """Crash-atomically write ``state`` (any picklable pytree; jax arrays
+    are device_get'd) to ``path``: tmp file + flush + fsync +
+    ``os.replace`` + parent-directory fsync (see
+    :mod:`moolib_tpu.utils.diskio`). A SIGKILL — or an injected
+    ENOSPC/EMFILE from the resource-exhaustion chaos family — at ANY
+    instant leaves the previous checkpoint intact; a torn new file can
+    never become the primary."""
     payload = {"magic": _MAGIC, "time": time.time(), "state": _to_host(state)}
-    d = os.path.dirname(os.path.abspath(path)) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(prefix=".ckpt-", dir=d)
-    try:
-        with os.fdopen(fd, "wb") as f:
-            # mkstemp creates 0600 files; restore normal umask-governed perms
-            # so other processes (eval, serving) can read the checkpoint.
-            umask = os.umask(0)
-            os.umask(umask)
-            try:
-                os.fchmod(fd, 0o666 & ~umask)
-            except OSError:
-                pass  # some network/FUSE mounts refuse fchmod; keep 0600
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+    with diskio.atomic_writer(path) as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
 
 
 def load_checkpoint(path: str) -> Any:

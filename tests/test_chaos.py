@@ -553,6 +553,51 @@ def test_chaos_shm_lane_fallback():
     assert summary == {"conn_kill": 2}, summary
 
 
+def test_chaos_statestore_host_loss(tmp_path):
+    """Host loss (ISSUE 15 acceptance): SIGKILL-equivalent death of a
+    member AND a wiped statestore directory; the same-name restart
+    restores the quorum-negotiated version from a peer replica
+    (byte-identical to the survivor's copy), rejoins, and its loss
+    trajectory matches the undisturbed control run — with publish,
+    replicate, kill, and restore all visible in ONE merged flightrec
+    timeline including the dead member's black box. Single scripted
+    conn_kill, so the injected-event log is replay-exact."""
+    from moolib_tpu.testing.scenarios import scenario_statestore_host_loss
+
+    summary = scenario_statestore_host_loss(seed=909,
+                                            tmpdir=str(tmp_path))
+    assert summary == {"conn_kill": 1}, summary
+
+
+def test_chaos_statestore_disk_full(tmp_path):
+    """Injected ENOSPC mid-checkpoint on the leader (ISSUE 15
+    acceptance): the failure is typed + counted + flight-recorded, no
+    torn or half-GC'd bundle survives (strict re-validation inside the
+    scenario), the cohort keeps training, and the durability role hands
+    to an extra follower while the leader is degraded. Fire counts are
+    cadence-dependent (like the straggler delays), so the event KINDS
+    are pinned, not the count."""
+    from moolib_tpu.testing.scenarios import scenario_statestore_disk_full
+
+    summary = scenario_statestore_disk_full(seed=1010,
+                                            tmpdir=str(tmp_path))
+    assert set(summary) == {"enospc"}, summary
+    assert summary["enospc"] >= 1, summary
+
+
+def test_chaos_statestore_bitflip(tmp_path):
+    """A seeded bit flip on one replica AFTER it verified and advertised
+    a version: negotiation still agrees, the puller hash-rejects exactly
+    one chunk, refetches it from the other holder, and the restore
+    completes — no wire faults, empty injected-event log, corruption
+    target replay-identical from the seed."""
+    from moolib_tpu.testing.scenarios import scenario_statestore_bitflip
+
+    summary = scenario_statestore_bitflip(seed=1111,
+                                          tmpdir=str(tmp_path))
+    assert summary == {}, summary
+
+
 def test_chaos_straggler_quorum_commit():
     """Straggler slow-link quorum commit: with min_quorum=2 the cohort
     commits a gradient round with N-1 contributions at the straggler

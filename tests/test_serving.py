@@ -469,10 +469,26 @@ def test_replica_overload_explicit_and_safe_to_retry():
             assert time.monotonic() < deadline
             time.sleep(0.02)
         x = np.ones(2, np.float32)
-        # Saturate rep0 directly: 1 in service + 2 queued.
-        direct = [router_rpc.call_with_deadline("ovrep0", "ov.infer", 20.0, x)
-                  for _ in range(3)]
-        time.sleep(0.2)
+        # Saturate rep0 directly: 1 in service + 2 queued. Sequenced
+        # against the replica's own admission state, not a sleep: if
+        # all three admits land before the serve loop pops the first
+        # request into service, the THIRD is refused at capacity and
+        # the replica ends up under-saturated (the 3/6 flake at HEAD) —
+        # so land one call, await its pop (inflight=1), then fill the
+        # queue and await depth=2, the exact state the Overloaded
+        # refusal below depends on.
+        direct = [router_rpc.call_with_deadline("ovrep0", "ov.infer",
+                                                20.0, x)]
+        deadline = time.monotonic() + 20
+        while rep0.admission.inflight < 1:
+            assert time.monotonic() < deadline, rep0.admission.inflight
+            time.sleep(0.01)
+        direct += [router_rpc.call_with_deadline("ovrep0", "ov.infer",
+                                                 20.0, x)
+                   for _ in range(2)]
+        while rep0.admission.depth < 2:
+            assert time.monotonic() < deadline, rep0.admission.depth
+            time.sleep(0.01)
         with pytest.raises(RpcError, match="Overloaded"):
             router_rpc.call_with_deadline(
                 "ovrep0", "ov.infer", 5.0, x).result(timeout=10)

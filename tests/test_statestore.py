@@ -1,0 +1,494 @@
+"""statestore tests: bundle format, crash-atomic disk protocol, the
+injectable disk-fault seam, wire replication, and the restore
+negotiation's edge cases.
+
+The three chaos scenarios (host-loss restore with loss continuity,
+ENOSPC mid-checkpoint, bit-flipped chunk refetch) live in
+test_chaos.py with the rest of the seeded-fault suite; here the layers
+are pinned in isolation: moolib_tpu/statestore/bundle.py's
+stage+fsync+rename protocol, StateStore's put/GC/degradation contract,
+the StateStoreService offer/ingest/commit wire family, Rpc.bulk, and
+negotiate()'s holder-disagreement / corrupt-manifest / in-flight-
+replication races (ISSUE 15 satellite).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu.rpc import Rpc, RpcError
+from moolib_tpu.statestore import (
+    LOCAL,
+    BundleCorrupt,
+    StateStore,
+    StateStoreError,
+    WriteFailed,
+)
+from moolib_tpu.statestore import bundle
+from moolib_tpu.testing.chaos import ResourceChaos, ResourceFaultPlan
+from moolib_tpu.utils import diskio
+
+
+# -- bundle format ------------------------------------------------------------
+
+
+def test_bundle_write_verify_roundtrip(tmp_path):
+    root = str(tmp_path / "store")
+    state = {"w": np.arange(300, dtype=np.float32), "step": 9}
+    blob = bundle.encode_state(state)
+    chunks = bundle.chunk_blob(blob, 128)
+    assert len(chunks) > 2 and b"".join(chunks) == blob
+    m = bundle.manifest_for(5, chunks)
+    bundle.write_version(root, 5, m, chunks)
+    assert bundle.list_versions(root) == [5]
+    back = bundle.verify_version(root, 5)
+    assert bundle.manifest_hash(back) == bundle.manifest_hash(m)
+    rebuilt = b"".join(bundle.read_chunk(root, 5, c["i"])
+                       for c in back["chunks"])
+    got = bundle.decode_state(rebuilt)
+    np.testing.assert_array_equal(got["w"], state["w"])
+    assert got["step"] == 9
+    # Versions are immutable: a second commit of v5 is refused.
+    with pytest.raises(FileExistsError):
+        bundle.write_version(root, 5, m, chunks)
+
+
+def test_manifest_hash_is_content_identity():
+    chunks = bundle.chunk_blob(b"x" * 1000, 256)
+    a = bundle.manifest_for(3, chunks)
+    b = bundle.manifest_for(3, chunks)
+    assert bundle.manifest_hash(a) == bundle.manifest_hash(b)
+    c = bundle.manifest_for(3, bundle.chunk_blob(b"y" * 1000, 256))
+    assert bundle.manifest_hash(a) != bundle.manifest_hash(c)
+
+
+def test_validate_manifest_rejects_malformed():
+    good = bundle.manifest_for(1, [b"abc"])
+    assert bundle.validate_manifest(good) is good
+    bad = [
+        {"magic": "nope"},
+        {**good, "extra": 1},
+        {**good, "version": -1},
+        {**good, "meta": []},
+        {**good, "chunks": []},
+        {**good, "chunks": [{"i": 1, "size": 3,
+                             "sha256": good["chunks"][0]["sha256"]}]},
+        {**good, "total_bytes": 99},
+    ]
+    for m in bad:
+        with pytest.raises(BundleCorrupt):
+            bundle.validate_manifest(m)
+
+
+def test_corrupt_chunk_and_truncation_detected(tmp_path):
+    root = str(tmp_path / "store")
+    chunks = bundle.chunk_blob(b"q" * 700, 256)
+    bundle.write_version(root, 2, bundle.manifest_for(2, chunks), chunks)
+    path = os.path.join(bundle.version_dir(root, 2), "c000001.bin")
+    raw = bytearray(open(path, "rb").read())
+    raw[10] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(raw)
+    with pytest.raises(BundleCorrupt, match="chunk 1"):
+        bundle.verify_version(root, 2)
+    os.unlink(path)
+    with pytest.raises(BundleCorrupt, match="missing"):
+        bundle.verify_version(root, 2)
+
+
+def test_sweep_clears_stage_and_gc_leftovers(tmp_path):
+    root = str(tmp_path / "store")
+    chunks = [b"z" * 64]
+    bundle.write_version(root, 1, bundle.manifest_for(1, chunks), chunks)
+    # What a crash mid-write / mid-GC strands:
+    os.makedirs(os.path.join(root, ".stage-v000000000002-abc"))
+    os.makedirs(os.path.join(root, ".gc-v000000000000-123"))
+    assert bundle.list_versions(root) == [1]  # leftovers are invisible
+    assert bundle.sweep(root) == 2
+    assert sorted(os.listdir(root)) == ["v000000000001"]
+
+
+def test_remove_version_is_rename_then_delete(tmp_path):
+    root = str(tmp_path / "store")
+    chunks = [b"a" * 32]
+    bundle.write_version(root, 4, bundle.manifest_for(4, chunks), chunks)
+    assert bundle.remove_version(root, 4) is True
+    assert bundle.list_versions(root) == []
+    assert bundle.remove_version(root, 4) is False  # idempotent
+
+
+# -- diskio fault seam --------------------------------------------------------
+
+
+def test_atomic_writer_injected_failure_leaves_target_untouched(tmp_path):
+    path = str(tmp_path / "f.bin")
+    diskio.write_file_atomic(path, b"old")
+
+    def hook(op, p):
+        if op == "fsync" and p == path:
+            raise OSError(28, "injected ENOSPC")
+
+    diskio.install_disk_fault_hook(hook)
+    try:
+        with pytest.raises(OSError, match="ENOSPC"):
+            diskio.write_file_atomic(path, b"new" * 1000)
+    finally:
+        diskio.uninstall_disk_fault_hook()
+    assert open(path, "rb").read() == b"old"  # previous version intact
+    assert [f for f in os.listdir(tmp_path)
+            if f.startswith(".tmp-")] == []  # no tmp leak on failure
+
+
+def test_resource_fault_plan_seeded_and_bounded():
+    from moolib_tpu.telemetry import Telemetry
+
+    plan = ResourceFaultPlan(3, telemetry=Telemetry("rfp-a")).enospc(
+        "v*/c*.bin", after=1, count=2
+    )
+    verdicts = [plan.decide_disk("write", f"v000000000001/c{i:06d}.bin")
+                for i in range(5)]
+    # after=1 skips the first match; count=2 bounds total injections.
+    assert [v is None for v in verdicts] == [True, False, False, True,
+                                             True]
+    assert all(v.errno == 28 for v in verdicts if v is not None)
+    # Unmatched ops/paths pass untouched.
+    assert plan.decide_disk("open", "v000000000001/c000000.bin") is None
+    assert plan.decide_disk("write", "elsewhere.bin") is None
+    # Decisions are pure in (seed, presented sequence): a replay plan
+    # fires at the same points, and the event log matches.
+    replay = ResourceFaultPlan(3, telemetry=Telemetry("rfp-b")).enospc(
+        "v*/c*.bin", after=1, count=2
+    )
+    for i in range(5):
+        r = replay.decide_disk("write", f"v000000000001/c{i:06d}.bin")
+        assert (r is None) == (verdicts[i] is None)
+    assert [(e.kind, e.arg) for e in plan.events] == \
+        [(e.kind, e.arg) for e in replay.events]
+    plan.verify_telemetry()
+
+
+# -- StateStore local contract ------------------------------------------------
+
+
+def test_store_put_load_gc_and_disk_budget(tmp_path):
+    store = StateStore(str(tmp_path / "s"), None, chunk_bytes=64,
+                       keep_versions=2, name="local")
+    try:
+        for v in range(1, 5):
+            store.put(v, {"w": np.full(50, v, np.float32)})
+        # keep_versions=2: oldest evicted, newest survive.
+        assert [v for v, _h in store.versions()] == [3, 4]
+        np.testing.assert_array_equal(
+            store.load(4)["w"], np.full(50, 4, np.float32)
+        )
+        reg = store._tel.registry
+        assert reg.value("statestore_gc_versions_total") == 2
+        assert reg.value("statestore_put_total") == 4
+    finally:
+        store.close()
+
+    # A byte budget evicts oldest-first but never the newest version.
+    store = StateStore(str(tmp_path / "b"), None, chunk_bytes=64,
+                       keep_versions=10, disk_budget_bytes=1,
+                       name="budget")
+    try:
+        store.put(1, {"w": np.zeros(50, np.float32)})
+        store.put(2, {"w": np.ones(50, np.float32)})
+        assert [v for v, _h in store.versions()] == [2]
+    finally:
+        store.close()
+
+
+def test_enospc_mid_put_is_typed_counted_recorded_and_recoverable(
+        tmp_path):
+    store = StateStore(str(tmp_path / "s"), None, chunk_bytes=64,
+                       name="faulty")
+    try:
+        store.put(1, {"w": np.zeros(40, np.float32)})
+        plan = ResourceFaultPlan(0).enospc("v*/*", op="write", after=1)
+        with ResourceChaos(plan, root=store.root):
+            with pytest.raises(WriteFailed) as ei:
+                store.put(2, {"w": np.ones(40, np.float32)})
+        assert isinstance(ei.value.__cause__, OSError)
+        assert ei.value.__cause__.errno == 28
+        assert store.degraded is True
+        reg = store._tel.registry
+        assert reg.value("statestore_write_failures_total",
+                         op="write") == 1
+        ev = [e for e in store._tel.flight.events()
+              if e["kind"] == "ss_write_failure"]
+        assert ev and ev[-1]["fields"]["version"] == 2
+        # No torn bundle: v1 still fully verifies, nothing of v2
+        # remains, no staging leftovers.
+        assert store.verify_all() == [1]
+        assert sorted(os.listdir(store.root)) == ["v000000000001"]
+        # Disk "freed": the next put succeeds and clears degraded.
+        store.put(3, {"w": np.full(40, 3, np.float32)})
+        assert store.degraded is False
+        assert [v for v, _h in store.versions()] == [1, 3]
+    finally:
+        store.close()
+
+
+def test_verified_cache_survives_post_verification_rot(tmp_path):
+    """A version verified once stays advertised even after its disk copy
+    rots — exactly the corrupt-holder case negotiation must survive
+    (the rot is detected at manifest/chunk FETCH time, by hash)."""
+    store = StateStore(str(tmp_path / "s"), None, chunk_bytes=64,
+                       name="rot")
+    try:
+        store.put(1, {"w": np.zeros(40, np.float32)})
+        advertised = store.versions()
+        assert len(advertised) == 1
+        path = os.path.join(bundle.version_dir(store.root, 1),
+                            "c000000.bin")
+        with open(path, "r+b") as f:
+            f.write(b"\xff")
+        assert store.versions() == advertised  # cache answers
+        with pytest.raises(BundleCorrupt):
+            store.verify_all()  # the strict audit sees through it
+    finally:
+        store.close()
+
+
+# -- wire family + replication ------------------------------------------------
+
+
+def _wire_trio(tmp_path, n=3, chunk_bytes=128):
+    rpcs = [Rpc(f"ssw{i}") for i in range(n)]
+    for r in rpcs:
+        r.listen("127.0.0.1:0")
+    for i, r in enumerate(rpcs):
+        for other in rpcs[i + 1:]:
+            r.connect(other.debug_info()["listen"][0])
+    stores = [StateStore(str(tmp_path / f"s{i}"), r,
+                         chunk_bytes=chunk_bytes, name=f"ssw{i}")
+              for i, r in enumerate(rpcs)]
+    return rpcs, stores
+
+
+def _close_all(rpcs, stores):
+    for s in stores:
+        s.close()
+    for r in rpcs:
+        r.close()
+
+
+def test_publish_replicate_offer_dedup_and_restore(tmp_path):
+    rpcs, stores = _wire_trio(tmp_path)
+    try:
+        state = {"w": np.arange(200, dtype=np.float64)}
+        acks = stores[0].publish(9, state, peers=("ssw1",))
+        assert acks == {LOCAL: True, "ssw1": True}
+        assert dict(stores[1].versions()) == dict(stores[0].versions())
+        # Re-offering an already-held version is acked without re-sending
+        # chunks (offer returns False -> no new ingest counted).
+        reg1 = rpcs[1].telemetry.registry
+        ingested = reg1.value("statestore_ingest_chunks_total")
+        assert stores[0].replicate(9, ("ssw1",)) == {"ssw1": True}
+        assert reg1.value("statestore_ingest_chunks_total") == ingested
+        # A third member with an empty disk restores from either holder.
+        got = stores[2].restore(("ssw0", "ssw1"), quorum=2)
+        assert got is not None and got[0] == 9
+        np.testing.assert_array_equal(got[1]["w"], state["w"])
+        assert dict(stores[2].versions()) == dict(stores[0].versions())
+    finally:
+        _close_all(rpcs, stores)
+
+
+def test_ingest_rejects_corrupt_chunk_commit_requires_all(tmp_path):
+    rpcs, stores = _wire_trio(tmp_path, n=2)
+    try:
+        chunks = bundle.chunk_blob(bundle.encode_state({"x": 1}), 64)
+        assert len(chunks) >= 2
+        m = bundle.manifest_for(4, chunks)
+        svc = StateStore.SERVICE
+        assert rpcs[0].sync("ssw1", f"{svc}::offer", m) is True
+        # A corrupt chunk is rejected AT INGEST (never enters staging).
+        with pytest.raises(RpcError, match="fails verification"):
+            rpcs[0].sync("ssw1", f"{svc}::ingest", 4, 0, b"\x00" * 64)
+        # Commit with chunks missing is refused, typed.
+        rpcs[0].sync("ssw1", f"{svc}::ingest", 4, 0, chunks[0])
+        with pytest.raises(RpcError, match="missing"):
+            rpcs[0].sync("ssw1", f"{svc}::commit", 4)
+        # Completing the ingest commits durably.
+        for i, c in enumerate(chunks[1:], start=1):
+            rpcs[0].sync("ssw1", f"{svc}::ingest", 4, i, c)
+        assert rpcs[0].sync("ssw1", f"{svc}::commit", 4) is True
+        assert [v for v, _h in stores[1].versions()] == [4]
+        # An ingest without any staged offer is refused.
+        with pytest.raises(RpcError, match="no staged offer"):
+            rpcs[0].sync("ssw1", f"{svc}::ingest", 99, 0, chunks[0])
+    finally:
+        _close_all(rpcs, stores)
+
+
+def test_one_statestore_per_rpc(tmp_path):
+    rpc = Rpc("sssingle")
+    store = StateStore(str(tmp_path / "a"), rpc, name="a")
+    try:
+        with pytest.raises(RuntimeError, match="already registered"):
+            StateStore(str(tmp_path / "b"), rpc, name="b")
+        store.close()
+        # close() undefines the wire family: a successor may register.
+        second = StateStore(str(tmp_path / "b"), rpc, name="b")
+        second.close()
+    finally:
+        rpc.close()
+
+
+def test_rpc_bulk_orders_results_and_captures_errors():
+    a, b = Rpc("bulk-a"), Rpc("bulk-b")
+    try:
+        b.define("double", lambda x: 2 * x)
+        b.define("boom", lambda: (_ for _ in ()).throw(ValueError("no")))
+        b.listen("127.0.0.1:0")
+        a.connect(b.debug_info()["listen"][0])
+        calls = [("bulk-b", "double", (i,)) for i in range(10)]
+        calls.insert(4, ("bulk-b", "boom", ()))
+        results = a.bulk(calls, window=3, timeout=20.0)
+        assert len(results) == 11
+        vals = [r for r, _e in results]
+        errs = [e for _r, e in results]
+        assert errs[4] is not None and isinstance(errs[4], RpcError)
+        assert vals[:4] == [0, 2, 4, 6] and vals[5:] == [8, 10, 12, 14,
+                                                         16, 18]
+        # One failure is one entry — every other call still completed.
+        assert sum(e is not None for e in errs) == 1
+        with pytest.raises(ValueError, match="window"):
+            a.bulk(calls, window=0)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- restore negotiation edge cases (ISSUE 15 satellite) ----------------------
+
+
+def test_negotiate_quorum_disagrees_on_newest_version(tmp_path):
+    """Two holders advertise the same newest version number with
+    DIFFERENT content (a torn world: e.g. a leader died between
+    divergent re-publishes): neither hash reaches quorum=2, so the
+    negotiation falls back to the newest version the quorum agrees on
+    — it must never pick a v5 'majority of one'."""
+    rpcs, stores = _wire_trio(tmp_path)
+    try:
+        agreed = {"w": np.arange(64, dtype=np.float32)}
+        assert all(stores[0].publish(4, agreed,
+                                     peers=("ssw1",)).values())
+        stores[0].put(5, {"w": np.zeros(64, np.float32)})
+        stores[1].put(5, {"w": np.ones(64, np.float32)})
+
+        neg = stores[2].negotiate(("ssw0", "ssw1"), quorum=2)
+        assert neg is not None and neg.version == 4
+        assert sorted(neg.holders) == ["ssw0", "ssw1"]
+        # With quorum=1 the divergent v5 IS pickable — and the hash tie
+        # (1 holder each) breaks deterministically, so every rejoiner
+        # negotiating the same advertisements picks the same copy.
+        n1 = stores[2].negotiate(("ssw0", "ssw1"), quorum=1)
+        n2 = stores[2].negotiate(("ssw1", "ssw0"), quorum=1)
+        assert n1.version == 5 and n1.manifest_hash == n2.manifest_hash
+    finally:
+        _close_all(rpcs, stores)
+
+
+def test_negotiate_lone_holder_with_corrupt_manifest(tmp_path):
+    """A lone holder advertises v7 from its verified cache, but its
+    on-disk manifest has since been tampered with: the fetched manifest
+    fails the advertised-hash check, the candidate is dropped (never
+    trusted), and the negotiation falls through to the next-newest
+    version that substantiates."""
+    rpcs, stores = _wire_trio(tmp_path, n=2)
+    try:
+        stores[0].put(6, {"w": np.full(30, 6.0, np.float32)})
+        stores[0].put(7, {"w": np.full(30, 7.0, np.float32)})
+        assert [v for v, _h in stores[0].versions()] == [6, 7]
+        # Tamper AFTER verification: still structurally valid JSON, so
+        # only the manifest-hash-vs-advertisement check can catch it.
+        mp = bundle.manifest_path(stores[0].root, 7)
+        m = json.load(open(mp))
+        m["meta"] = {"tampered": True}
+        with open(mp, "w") as f:
+            json.dump(m, f)
+
+        neg = stores[1].negotiate(("ssw0",), quorum=1)
+        assert neg is not None and neg.version == 6
+        # The pull agrees: restore lands v6, not the tampered v7.
+        got = stores[1].restore(("ssw0",), quorum=1)
+        assert got is not None and got[0] == 6
+        np.testing.assert_array_equal(got[1]["w"],
+                                      np.full(30, 6.0, np.float32))
+        # When NOTHING else substantiates, the answer is None — not a
+        # restore of unverifiable bytes. (The earlier restore() made
+        # stores[1] a v6 holder itself; drop both copies so only the
+        # tampered v7 remains anywhere.)
+        for st in stores:
+            assert bundle.remove_version(st.root, 6)
+            st._verified.pop(6, None)
+        assert stores[1].negotiate(("ssw0",), quorum=1) is None
+    finally:
+        _close_all(rpcs, stores)
+
+
+def test_rejoiner_races_inflight_replication_of_newer_version(tmp_path):
+    """A rejoiner negotiates WHILE a newer version's replication is
+    in flight (offered + partially ingested, not committed) on the
+    holder it asks: the staged version must be invisible — only
+    committed-and-verified versions are advertised — so the rejoiner
+    restores v5 now, and sees v6 only after the commit lands."""
+    rpcs, stores = _wire_trio(tmp_path)
+    try:
+        state5 = {"w": np.full(80, 5.0, np.float32)}
+        assert all(stores[0].publish(5, state5,
+                                     peers=("ssw1",)).values())
+        # v6 replication caught mid-flight into ssw1: offer accepted,
+        # first chunk ingested, commit NOT yet sent.
+        chunks6 = bundle.chunk_blob(
+            bundle.encode_state({"w": np.full(80, 6.0, np.float32)}), 128
+        )
+        assert len(chunks6) >= 2
+        m6 = bundle.manifest_for(6, chunks6)
+        svc = StateStore.SERVICE
+        assert rpcs[0].sync("ssw1", f"{svc}::offer", m6) is True
+        rpcs[0].sync("ssw1", f"{svc}::ingest", 6, 0, chunks6[0])
+
+        got = stores[2].restore(("ssw1",), quorum=1)
+        assert got is not None and got[0] == 5
+        np.testing.assert_array_equal(got[1]["w"], state5["w"])
+
+        # The in-flight replication completes; the next negotiation
+        # (same peers, same quorum) now agrees on v6.
+        for i, c in enumerate(chunks6[1:], start=1):
+            rpcs[0].sync("ssw1", f"{svc}::ingest", 6, i, c)
+        assert rpcs[0].sync("ssw1", f"{svc}::commit", 6) is True
+        neg = stores[2].negotiate(("ssw1",), quorum=1)
+        assert neg is not None and neg.version == 6
+    finally:
+        _close_all(rpcs, stores)
+
+
+def test_restore_repairs_corrupt_local_copy_from_peers(tmp_path):
+    """The rejoiner's own disk holds the negotiated version but the
+    copy is rotten: load fails, the corrupt local copy is dropped, the
+    chunks are pulled from a surviving holder, and the member ends up a
+    verified holder again (self-repair, not an error)."""
+    rpcs, stores = _wire_trio(tmp_path, n=2)
+    try:
+        state = {"w": np.arange(120, dtype=np.float64)}
+        assert all(stores[0].publish(3, state, peers=("ssw1",)).values())
+        # Rot a chunk on ssw0 AFTER verification (it keeps advertising).
+        path = os.path.join(bundle.version_dir(stores[0].root, 3),
+                            "c000001.bin")
+        with open(path, "r+b") as f:
+            f.seek(2)
+            f.write(b"\xde\xad")
+        got = stores[0].restore(("ssw1",), quorum=2)
+        assert got is not None and got[0] == 3
+        np.testing.assert_array_equal(got[1]["w"], state["w"])
+        assert stores[0].verify_all() == [3]  # repaired on disk too
+    finally:
+        _close_all(rpcs, stores)

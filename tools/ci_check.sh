@@ -91,6 +91,19 @@ env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke --locktrace
 timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_shmring.py -q -p no:cacheprovider
 
+echo "== statestore restore smoke =="
+# Durable-state round trip (docs/reliability.md, "Durable state"):
+# publish a model-sized version to two live replicas over the
+# StateStoreService wire family, wipe the publisher's disk (host loss),
+# and restore it on the same member via quorum-2 negotiation + verified
+# chunk pull — with the statestore_* counters and ss_* flightrec events
+# checked as evidence. The chaos pass above already runs the three
+# statestore scenarios (host-loss trajectory continuity, ENOSPC
+# mid-checkpoint, bit-flipped chunk refetch) under locktrace; this
+# stage pins the plain-path restore in isolation so a wire-family or
+# negotiation regression is named here, in seconds.
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/statestore_smoke.py
+
 echo "== incident smoke =="
 # flightrec end-to-end (docs/incidents.md): an in-process cohort under a
 # seeded FaultPlan is deliberately driven through faults, then crawled

@@ -60,6 +60,12 @@ MODULES = [
      "causally-ordered cross-peer timeline merge"),
     ("moolib_tpu.flightrec.crawl", "the one cohort-crawl implementation "
      "shared by the dump/report tools"),
+    ("moolib_tpu.statestore", "peer-replicated durable training state: "
+     "content-hashed bundles, restore negotiation, async replication"),
+    ("moolib_tpu.statestore.bundle", "on-disk bundle format: chunked, "
+     "per-chunk sha256, crash-atomic stage+rename writes"),
+    ("moolib_tpu.statestore.store", "StateStore wire family + restore "
+     "negotiation + the Accumulator-attached Replicator"),
     ("moolib_tpu.testing.chaos", "chaosnet: deterministic seeded fault "
      "injection (FaultPlan engine + ChaosNet installer)"),
     ("moolib_tpu.testing.scenarios", "canonical chaos scenarios shared by "
@@ -105,6 +111,8 @@ MODULES = [
     ("moolib_tpu.models.nethack", "NetHack dict-obs model"),
     ("moolib_tpu.learner", "jitted IMPALA train step + train state"),
     ("moolib_tpu.utils.checkpoint", "atomic checkpoint/resume"),
+    ("moolib_tpu.utils.diskio", "crash-atomic disk writes + the "
+     "injectable disk-fault seam"),
     ("moolib_tpu.utils.profiling", "XLA profiler capture"),
     ("moolib_tpu.utils.flops", "analytic FLOPs accounting / MFU"),
     ("moolib_tpu.utils.nest", "nested-structure utilities"),
