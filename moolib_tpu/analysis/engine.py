@@ -35,6 +35,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import fnmatch
+import hashlib
 import json
 import re
 import time
@@ -44,6 +45,7 @@ from pathlib import Path
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 __all__ = [
+    "DEFAULT_CACHE",
     "EndpointDef",
     "EndpointSig",
     "Finding",
@@ -70,6 +72,13 @@ __all__ = [
 ]
 
 BASELINE_VERSION = 1
+CACHE_VERSION = 1
+#: Per-file result cache, stored beside the baselines (gitignored). See
+#: :func:`lint_paths` — sections are keyed by a whole-project hash, so
+#: the interprocedural layer stays sound: editing ANY linted file (or
+#: any analysis module) starts a fresh section.
+DEFAULT_CACHE = Path(__file__).resolve().parent / "lint_cache.json"
+_CACHE_KEEP_PROJECTS = 4
 
 _SUPPRESS_RE = re.compile(r"#\s*moolint:\s*disable=([\w\-,]+)")
 _SUPPRESS_FILE_RE = re.compile(r"#\s*moolint:\s*disable-file=([\w\-,]+)")
@@ -638,16 +647,17 @@ def all_rules() -> List[Rule]:
     """The full registered rule set (async-safety + JAX trace hygiene +
     sharding/collective consistency + RPC round/counter balance + RPC
     wire-surface consistency + benchmark timing hygiene + guarded-field
-    / lock-order race analysis)."""
-    from . import (rules_async, rules_bench, rules_jax, rules_protocol,
-                   rules_race, rules_sharding, rules_wire)
+    / lock-order race analysis + resource-lifecycle / shutdown-path
+    analysis)."""
+    from . import (rules_async, rules_bench, rules_jax, rules_lifecycle,
+                   rules_protocol, rules_race, rules_sharding, rules_wire)
 
     return [
         cls()
         for cls in (rules_async.RULES + rules_jax.RULES
                     + rules_sharding.RULES + rules_protocol.RULES
                     + rules_wire.RULES + rules_bench.RULES
-                    + rules_race.RULES)
+                    + rules_race.RULES + rules_lifecycle.RULES)
     ]
 
 
@@ -721,21 +731,75 @@ def list_lint_files(paths: Sequence[Path],
     return out
 
 
+def _ruleset_hash(selected: Sequence[Rule]) -> str:
+    """Hash of the selected rule names PLUS the analysis package's own
+    source: editing any rule module (or this engine) must invalidate
+    every cached result, not just renamed rules."""
+    h = hashlib.sha256()
+    for name in sorted(r.name for r in selected):
+        h.update(name.encode())
+        h.update(b"\0")
+    pkg = Path(__file__).resolve().parent
+    for mod in sorted(pkg.glob("*.py")):
+        h.update(mod.name.encode())
+        try:
+            h.update(hashlib.sha256(mod.read_bytes()).digest())
+        except OSError:
+            h.update(b"?")
+    return h.hexdigest()
+
+
+def _load_cache(path: Path) -> dict:
+    try:
+        data = json.loads(Path(path).read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return {"version": CACHE_VERSION, "stamp": 0, "projects": {}}
+    if not isinstance(data, dict) or data.get("version") != CACHE_VERSION \
+            or not isinstance(data.get("projects"), dict):
+        return {"version": CACHE_VERSION, "stamp": 0, "projects": {}}
+    return data
+
+
+def _save_cache(path: Path, data: dict) -> None:
+    # Keep only the newest sections: a repo being edited cycles through
+    # project hashes fast, and each section holds every file's findings.
+    projects = data["projects"]
+    if len(projects) > _CACHE_KEEP_PROJECTS:
+        keep = sorted(projects, key=lambda k: projects[k].get("stamp", 0),
+                      reverse=True)[:_CACHE_KEEP_PROJECTS]
+        data["projects"] = {k: projects[k] for k in keep}
+    try:
+        Path(path).write_text(json.dumps(data) + "\n", encoding="utf-8")
+    except OSError:
+        pass  # a read-only checkout lints fine, just uncached
+
+
 def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
                rules: Optional[Sequence[Rule]] = None,
                only: Optional[Sequence[str]] = None,
-               timings: Optional[Dict[str, float]] = None) -> List[Finding]:
+               timings: Optional[Dict[str, float]] = None,
+               cache_path: Optional[Path] = None,
+               cache_stats: Optional[Dict[str, int]] = None) -> List[Finding]:
     """Lint files/trees. ``root`` anchors the relative paths findings carry
     (default: the current working directory); files outside ``root`` fall
     back to absolute paths so they can never collide with baselined ones.
     When ``timings`` is a dict it receives per-rule wall-time (rule name
     -> cumulative seconds across all files) — the profiling surface
-    behind ``moolint --rule-times``."""
+    behind ``moolint --rule-times``.
+
+    ``cache_path`` enables the per-file result cache: results are keyed
+    by each file's content hash *inside a section keyed by the hash of
+    the whole linted file set plus the analysis package itself*, so the
+    interprocedural layer stays sound — ANY edit anywhere opens a fresh
+    section and every file re-lints; the common no-change run is all
+    hits and ~instant. ``cache_stats`` (a dict) receives ``hits`` /
+    ``misses`` counters for ``--rule-times`` reporting."""
     root = Path(root) if root is not None else Path.cwd()
     selected = _select_rules(rules, only)
     # Phase 1: parse everything, so phase 2 rules can resolve names across
     # modules through the shared ProjectIndex.
     contexts: List[ModuleContext] = []
+    file_hashes: Dict[str, str] = {}
     for path in iter_py_files(paths):
         try:
             source = path.read_text(encoding="utf-8")
@@ -752,18 +816,62 @@ def lint_paths(paths: Sequence[Path], root: Optional[Path] = None,
             # import suite); the linter skips it rather than masking every
             # other finding behind one broken scratch file.
             continue
+        file_hashes[rel] = hashlib.sha256(source.encode()).hexdigest()
     project = ProjectIndex(contexts)
+
+    cache = section = None
+    if cache_path is not None:
+        h = hashlib.sha256(_ruleset_hash(selected).encode())
+        for rel in sorted(file_hashes):
+            h.update(rel.encode())
+            h.update(file_hashes[rel].encode())
+        project_key = h.hexdigest()
+        cache = _load_cache(cache_path)
+        cache["stamp"] = int(cache.get("stamp", 0)) + 1
+        section = cache["projects"].setdefault(
+            project_key, {"files": {}}
+        )
+        section["stamp"] = cache["stamp"]
+    if cache_stats is not None:
+        cache_stats.setdefault("hits", 0)
+        cache_stats.setdefault("misses", 0)
+
     out: List[Finding] = []
+    dirty = False
     for ctx in contexts:
         assert ctx.project is project
+        if section is not None:
+            entry = section["files"].get(ctx.relpath)
+            if entry is not None \
+                    and entry.get("hash") == file_hashes[ctx.relpath]:
+                # Sound by construction: this section's key covers every
+                # linted file AND the analysis source, so a hash-matched
+                # entry was produced by exactly this run's inputs.
+                out.extend(Finding(**d) for d in entry["findings"])
+                if cache_stats is not None:
+                    cache_stats["hits"] += 1
+                continue
+        if cache_stats is not None and section is not None:
+            cache_stats["misses"] += 1
+        file_findings: List[Finding] = []
         for rule in selected:
             t0 = time.perf_counter() if timings is not None else 0.0
             for f in rule.check(ctx):
                 if not ctx.suppressed(f.rule, f.line):
-                    out.append(f)
+                    file_findings.append(f)
             if timings is not None:
                 timings[rule.name] = timings.get(rule.name, 0.0) \
                     + (time.perf_counter() - t0)
+        out.extend(file_findings)
+        if section is not None:
+            section["files"][ctx.relpath] = {
+                "hash": file_hashes[ctx.relpath],
+                "findings": [f.to_dict() for f in file_findings],
+            }
+            dirty = True
+    if cache is not None and (dirty or len(cache["projects"]) >
+                              _CACHE_KEEP_PROJECTS):
+        _save_cache(cache_path, cache)
     return sorted(out)
 
 

@@ -501,6 +501,61 @@ def _worker_main(conn, env_fn_bytes: bytes, first: int, count: int, rank: int):
                 pass
 
 
+def _drain_entry(wref):
+    """Pipe-mode drain thread body (completion collection in
+    ``EnvPool._drain_once``). Holds the pool only through a WEAKREF
+    between ticks — a bound-method target would strongly pin the pool,
+    so an abandoned pool (dropped without close()) could never be
+    collected and its ``__del__`` close() backstop would never run (the
+    PR-12 bug class; same contract as ``_supervise_entry``)."""
+    while True:
+        pool = wref()
+        if pool is None:
+            return  # pool collected: __del__ -> close() already cleaned up
+        try:
+            if pool._closed or not pool._drain_once():
+                return
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            # Cancellation of the drain thread: wake every waiter (their
+            # result() sees the recorded error), then PROPAGATE — the
+            # invoker decides what cancellation means.
+            pool._fatal = pool._fatal or "drain loop cancelled"
+            pool._fail_all_waiters()
+            raise
+        except Exception as e:
+            pool._fatal = f"{type(e).__name__}: {e}"
+            pool._fail_all_waiters()
+            return
+        finally:
+            del pool  # never hold the strong ref across the next deref
+
+
+def _notify_entry(wref):
+    """Native-mode notify thread body (semaphore-driven completion scan
+    in ``EnvPool._notify_once``), under the same weakref contract as
+    ``_supervise_entry``/``_drain_entry``: the pool is held strongly only
+    for one bounded tick, so abandonment still collects it."""
+    while True:
+        pool = wref()
+        if pool is None:
+            return  # pool collected: __del__ -> close() already cleaned up
+        try:
+            if pool._closed or not pool._notify_once():
+                return
+        except (asyncio.CancelledError, concurrent.futures.CancelledError):
+            # Same contract as the drain thread: restore waiter liveness,
+            # then propagate the cancellation instead of eating it.
+            pool._fatal = pool._fatal or "notify loop cancelled"
+            pool._fail_all_waiters()
+            raise
+        except Exception as e:
+            pool._fatal = f"{type(e).__name__}: {e}"
+            pool._fail_all_waiters()
+            return
+        finally:
+            del pool  # never hold the strong ref across the next deref
+
+
 def _supervise_entry(wref, interval: float):
     """Supervisor thread body: death detection, the hung-step watchdog,
     and the respawn schedule (all in ``EnvPool._sup_tick``). Holds the
@@ -865,8 +920,11 @@ class EnvPool:
         self._supervisor = None
         if self._ctrl is None:
             # Pipe mode: background thread collects per-worker completions.
+            # Weakref target, like _supervisor below: the drain thread
+            # must never pin an abandoned pool against GC.
             self._waiter = threading.Thread(
-                target=self._drain_loop, daemon=True, name="envpool-drain",
+                target=_drain_entry, args=(weakref.ref(self),),
+                daemon=True, name="envpool-drain",
             )
             self._waiter.start()
         if self._supervise:
@@ -1112,7 +1170,7 @@ class EnvPool:
         with the per-buffer done semaphore as the wakeup.
 
         Shares the awaited-worker set (under the lock) with
-        ``_notify_loop``: when a callback registers mid-wait, the notify
+        ``_notify_once``: when a callback registers mid-wait, the notify
         loop starts consuming the same done semaphores, so this waiter
         falls back to the completion event once the callback path owns the
         drain. Completion is decided by the marks, never by post counts —
@@ -1208,54 +1266,45 @@ class EnvPool:
                             why=str(why)[:200])
         log.error("env %d quarantined as poison: %s", gi, why)
 
-    def _drain_loop(self):
-        """Pipe-mode background thread: collects worker completions (and
-        quarantine/error reports) for all buffers; with supervision on,
-        routes a dead worker into the respawn path instead of failing the
-        pool."""
+    def _drain_once(self) -> bool:
+        """One pipe-mode drain tick (bounded by the 0.25s pipe wait):
+        collects worker completions (and quarantine/error reports) for
+        all buffers; with supervision on, routes a dead worker into the
+        respawn path instead of failing the pool. Returns False when the
+        drain thread should exit; driven by :func:`_drain_entry` (the
+        weakref thread contract — failures are handled there)."""
         import multiprocessing.connection as mpc
 
+        with self._lock:
+            conns = {
+                self._conns[w]: w
+                for w in range(self.num_processes)
+                if self._alive[w] and self._conns[w] is not None
+            }
+        if not conns:
+            time.sleep(0.05)
+            return True
         try:
-            while not self._closed:  # racelint: unguarded -- close latch: set once; a stale read delays exit by one 0.25s slice
-                with self._lock:
-                    conns = {
-                        self._conns[w]: w
-                        for w in range(self.num_processes)
-                        if self._alive[w] and self._conns[w] is not None
-                    }
-                if not conns:
-                    time.sleep(0.05)
+            ready = mpc.wait(list(conns), timeout=0.25)
+        except (OSError, ValueError):
+            return True  # a conn was swapped/closed under the wait
+        for conn in ready:
+            w = conns[conn]
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                if self._closed:
+                    return False
+                if self._supervise:
+                    self._on_worker_death(
+                        w, "exit", "worker pipe closed", conn=conn
+                    )
                     continue
-                try:
-                    ready = mpc.wait(list(conns), timeout=0.25)
-                except (OSError, ValueError):
-                    continue  # a conn was swapped/closed under the wait
-                for conn in ready:
-                    w = conns[conn]
-                    try:
-                        msg = conn.recv()
-                    except (EOFError, OSError):
-                        if self._closed:
-                            return
-                        if self._supervise:
-                            self._on_worker_death(
-                                w, "exit", "worker pipe closed", conn=conn
-                            )
-                            continue
-                        self._fatal = "worker pipe closed"
-                        self._fail_all_waiters()
-                        return
-                    self._on_worker_msg(w, msg)
-        except (asyncio.CancelledError, concurrent.futures.CancelledError):
-            # Cancellation of the drain thread: wake every waiter (their
-            # result() sees the recorded error), then PROPAGATE — the
-            # invoker decides what cancellation means.
-            self._fatal = self._fatal or "drain loop cancelled"
-            self._fail_all_waiters()
-            raise
-        except Exception as e:
-            self._fatal = f"{type(e).__name__}: {e}"
-            self._fail_all_waiters()
+                self._fatal = "worker pipe closed"
+                self._fail_all_waiters()
+                return False
+            self._on_worker_msg(w, msg)
+        return True
 
     # -- supervision ----------------------------------------------------------
 
@@ -1577,8 +1626,8 @@ class EnvPool:
                     # below forces a first scan.
                     self._ctrl.flag_view(self._shm.buf)[0] = 1
                     self._notify_thread = threading.Thread(
-                        target=self._notify_loop, daemon=True,
-                        name="envpool-notify",
+                        target=_notify_entry, args=(weakref.ref(self),),
+                        daemon=True, name="envpool-notify",
                     )
                     self._notify_thread.start()
         if fire_now:
@@ -1588,44 +1637,38 @@ class EnvPool:
             # by an earlier scan): force one fresh scan.
             self._native.sem_post(self._shm.buf, self._ctrl.notify_sem)
 
-    def _notify_loop(self):
-        """Single event-driven completion thread for ALL buffers: blocks on
-        the control block's notify semaphore (posted by every worker after
-        every step slice), attributes completions via the per-worker marks
-        (non-blocking drains of the per-buffer done semaphores are just
-        wakeup bookkeeping), and fires callbacks (reference: one
-        semaphore-driven server serves 256 clients, src/env.h:46)."""
+    def _notify_once(self) -> bool:
+        """One tick of the single event-driven completion thread for ALL
+        buffers: blocks (up to 0.5s) on the control block's notify
+        semaphore (posted by every worker after every step slice),
+        attributes completions via the per-worker marks (non-blocking
+        drains of the per-buffer done semaphores are just wakeup
+        bookkeeping), and fires callbacks (reference: one
+        semaphore-driven server serves 256 clients, src/env.h:46).
+        Returns False when the notify thread should exit; driven by
+        :func:`_notify_entry` (the weakref thread contract — failures
+        are handled there)."""
         native, ctrl = self._native, self._ctrl
-        try:
-            while not self._closed:  # racelint: unguarded -- close latch: set once; a stale read delays exit by one 0.5s slice
-                woke = native.sem_wait(self._shm.buf, ctrl.notify_sem, 0.5)
-                fired = []
-                with self._lock:
-                    for b in list(self._callbacks):
-                        while self._await[b] and native.sem_wait(
-                            self._shm.buf, ctrl.done_sems[b], 0.0
-                        ):
-                            pass  # posts are wakeups; marks decide
-                        if self._busy[b] and self._scan_locked(b):
-                            self._events[b].set()
-                            fired.extend(self._callbacks.pop(b))
-                if fired:
-                    self._run_callbacks(fired)
-                elif not woke and not self._closed and not self._supervise:
-                    try:
-                        self._check_workers_alive()
-                    except RuntimeError:
-                        self._fail_all_waiters()
-                        return
-        except (asyncio.CancelledError, concurrent.futures.CancelledError):
-            # Same contract as _drain_loop: restore waiter liveness, then
-            # propagate the cancellation instead of eating it.
-            self._fatal = self._fatal or "notify loop cancelled"
-            self._fail_all_waiters()
-            raise
-        except Exception as e:
-            self._fatal = f"{type(e).__name__}: {e}"
-            self._fail_all_waiters()
+        woke = native.sem_wait(self._shm.buf, ctrl.notify_sem, 0.5)
+        fired = []
+        with self._lock:
+            for b in list(self._callbacks):
+                while self._await[b] and native.sem_wait(
+                    self._shm.buf, ctrl.done_sems[b], 0.0
+                ):
+                    pass  # posts are wakeups; marks decide
+                if self._busy[b] and self._scan_locked(b):
+                    self._events[b].set()
+                    fired.extend(self._callbacks.pop(b))
+        if fired:
+            self._run_callbacks(fired)
+        elif not woke and not self._closed and not self._supervise:
+            try:
+                self._check_workers_alive()
+            except RuntimeError:
+                self._fail_all_waiters()
+                return False
+        return True
 
     def _run_callbacks(self, items):
         for fn, fut in items:
@@ -1803,7 +1846,7 @@ class EnvPool:
     def __exit__(self, *exc):
         self.close()
 
-    def __del__(self):
+    def __del__(self):  # lifelint: intentional -- documented abandoned-pool backstop; close() is latched idempotent and the weakref'd worker threads guarantee this can actually run
         try:
             self.close()
         except (asyncio.CancelledError, concurrent.futures.CancelledError):

@@ -94,6 +94,7 @@ class EnvPoolServer:
         self.name = name
         self.lease_timeout = lease_timeout
         self._lock = threading.Lock()
+        self._closed = False
         self._free = list(range(pool.num_batches))
         self._owners: dict = {}
         self._last_step: dict = {}
@@ -264,6 +265,9 @@ class EnvPoolServer:
         fut.add_done_callback(on_done)
 
     def close(self):
+        if self._closed:  # the close() idempotence contract
+            return
+        self._closed = True
         reg = self.rpc.telemetry.registry
         for gname in ("envpool_buffers_free", "envpool_clients"):
             reg.unregister(gname, pool=self.name)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -49,6 +50,20 @@ def obs_from_env_out(env_out):
     return {k: env_out[k] for k in obs_keys}
 
 
+def _broker_pump_entry(wref, stop, interval):
+    """Broker-pump thread entry (the weakref thread contract,
+    docs/reliability.md): holds the InProcessBroker only for one update
+    tick, so an abandoned broker is still collectable instead of being
+    pinned forever by its own pump thread (the PR-12 bug class)."""
+    while not stop.is_set():
+        b = wref()
+        if b is None:
+            return
+        b._broker.update()
+        del b
+        stop.wait(interval)
+
+
 class InProcessBroker:
     """Broker on a background thread, for single-process runs
     (reference: the a2c example starts its own Broker in-process,
@@ -62,17 +77,19 @@ class InProcessBroker:
         self.rpc.listen("127.0.0.1:0")
         self.address = self.rpc.debug_info()["listen"][0]
         self._broker = Broker(self.rpc)
-        self._interval = update_interval
+        self._closed = False
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=_broker_pump_entry,
+            args=(weakref.ref(self), self._stop, update_interval),
+            daemon=True,
+        )
         self._thread.start()
 
-    def _run(self):
-        while not self._stop.is_set():
-            self._broker.update()
-            time.sleep(self._interval)
-
     def close(self):
+        if self._closed:  # the close() idempotence contract
+            return
+        self._closed = True
         self._stop.set()
         self._thread.join(timeout=5)
         self.rpc.close()

@@ -439,12 +439,17 @@ class Accumulator:
                      lambda: 1.0 if wself().is_leader() else 0.0)
         reg.gauge_fn("acc_dark_failures", lambda: wself()._dark_failures)
 
+        self._endpoint_names = (
+            "AccumulatorService::requestState",
+            "AccumulatorService::pushState",
+        )
         rpc.define(
             "AccumulatorService::requestState", self._serve_state
         )
         rpc.define(
             "AccumulatorService::pushState", self._on_push_state
         )
+        self._closed = False
 
     # -- reference-parity introspection --------------------------------------
 
@@ -1464,8 +1469,13 @@ class Accumulator:
             }
 
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
         reg = self.rpc.telemetry.registry
         for name in self._gauge_names:
             reg.unregister(name)
+        for name in self._endpoint_names:
+            self.rpc.undefine(name)
         if self._owns_group:
             self.group.close()

@@ -159,6 +159,7 @@ class Group:
         # instead of queueing rounds that can only time out.
         self.broker_grace = max(3.0 * timeout, 15.0)
         self._grace_explicit = False  # set_broker_grace pins it
+        self._closed = False  # close() idempotence latch
         self._lock = threading.RLock()
         self._sync_id: Optional[str] = None
         self._members: List[str] = []
@@ -259,10 +260,14 @@ class Group:
             # chunk-sized elementwise reduce at most) and never block. Heavy
             # completion work (pytree reassembly) is explicitly offloaded —
             # see _completion_executor.
-            rpc.define("GroupService::update", self._on_update, inline=True)
-            rpc.define("AllReduceService::reduce", self._on_reduce,
+            # The _Shared registrar is a per-Rpc singleton (one per
+            # `rpc._moolib_group_shared`): these endpoints serve every
+            # Group the rpc ever hosts and die with the rpc itself, so
+            # there is deliberately no per-Group undefine.
+            rpc.define("GroupService::update", self._on_update, inline=True)  # lifelint: intentional -- per-Rpc singleton endpoint, lives for the rpc's lifetime
+            rpc.define("AllReduceService::reduce", self._on_reduce,  # lifelint: intentional -- per-Rpc singleton endpoint, lives for the rpc's lifetime
                        inline=True)
-            rpc.define("AllReduceService::share", self._on_share, inline=True)
+            rpc.define("AllReduceService::share", self._on_share, inline=True)  # lifelint: intentional -- per-Rpc singleton endpoint, lives for the rpc's lifetime
 
         def register(self, group: "Group"):
             self.groups[group.group_name] = group
@@ -923,6 +928,9 @@ class Group:
         )
 
     def close(self):
+        if self._closed:  # the close() idempotence contract
+            return
+        self._closed = True
         reg = self.rpc.telemetry.registry
         for name in self._gauge_names:
             reg.unregister(name, group=self.group_name)

@@ -827,7 +827,7 @@ class Rpc:
             max_workers=_executor_workers(), thread_name_prefix=f"{self._name}-fn"
         )
         self._loop = asyncio.new_event_loop()
-        self._thread = threading.Thread(
+        self._thread = threading.Thread(  # lifelint: intentional -- the asyncio loop's own tasks (bound coroutines) pin self regardless of the Thread target; Rpc lifetime is the explicit close() contract + atexit backstop
             target=self._loop_main, name=f"{self._name}-io", daemon=True
         )
         self._started = threading.Event()

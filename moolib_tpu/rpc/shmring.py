@@ -284,7 +284,7 @@ class ShmLane:
         self._unlink: List[str] = (
             [path, path + ".db0", path + ".db1"] if created else []
         )
-        self._finalizer = weakref.finalize(
+        self._finalizer = weakref.finalize(  # lifelint: intentional -- documented /dev/shm leak backstop: lock-free close+unlink, runs at most once, close() invokes the same finalizer
             self, _cleanup, mm, self._fds, self._unlink
         )
 

@@ -53,6 +53,21 @@ log = get_logger("serving")
 ENDPOINT_SUFFIXES = ("infer", "health", "load", "drain")
 
 
+def _serve_entry(wref, stop):
+    """Serve-thread entry (the weakref thread contract,
+    docs/reliability.md): the thread holds the Replica only for one
+    bounded batch tick (a 0.1s pop plus any admitted batch), so an
+    abandoned replica (dropped without close()) is still collectable
+    instead of being pinned forever by its own worker (the PR-12 bug
+    class)."""
+    while not stop.is_set():
+        replica = wref()
+        if replica is None:
+            return
+        replica._serve_once()
+        del replica
+
+
 class Replica:
     """A serving replica on an existing ``Rpc`` peer.
 
@@ -124,7 +139,7 @@ class Replica:
         rpc.define_deferred(f"{service}.drain", self._on_drain)
 
         self._worker = threading.Thread(
-            target=self._serve_loop,
+            target=_serve_entry, args=(weakref.ref(self), self._stop),
             name=f"{rpc.get_name()}-{service}-serve", daemon=True,
         )
         self._worker.start()
@@ -186,29 +201,31 @@ class Replica:
 
     # -- the batch loop ------------------------------------------------------
 
-    def _serve_loop(self):
-        while not self._stop.is_set():
-            try:
-                serve, shed = self.admission.get_batch(
-                    self.batch_size, timeout=0.1, linger=self.linger_s
+    def _serve_once(self):
+        """One bounded serve tick (pop + batch); driven by
+        :func:`_serve_entry` so the worker never holds ``self`` across a
+        wait."""
+        try:
+            serve, shed = self.admission.get_batch(
+                self.batch_size, timeout=0.1, linger=self.linger_s
+            )
+        except (asyncio.CancelledError,
+                concurrent.futures.CancelledError):
+            raise  # never swallow task cancellation
+        except Exception as e:
+            log.error("serve loop pop failed: %s", e)
+            return
+        if shed:
+            for dr, _x in shed:
+                self._reply_error(
+                    dr,
+                    "DeadlineExceeded: remaining budget cannot cover "
+                    "the observed p50 service time (shed in queue)",
                 )
-            except (asyncio.CancelledError,
-                    concurrent.futures.CancelledError):
-                raise  # never swallow task cancellation
-            except Exception as e:
-                log.error("serve loop pop failed: %s", e)
-                continue
-            if shed:
-                for dr, _x in shed:
-                    self._reply_error(
-                        dr,
-                        "DeadlineExceeded: remaining budget cannot cover "
-                        "the observed p50 service time (shed in queue)",
-                    )
-                self.admission.fail(len(shed), shed=True)
-            if not serve:
-                continue
-            self._run_batch(serve)
+            self.admission.fail(len(shed), shed=True)
+        if not serve:
+            return
+        self._run_batch(serve)
 
     def _run_batch(self, serve):
         n = len(serve)

@@ -30,6 +30,7 @@ import asyncio
 import concurrent.futures
 import threading
 import time
+import weakref
 from random import Random
 from typing import Any, Dict, List, Optional
 
@@ -41,6 +42,20 @@ from .health import CircuitBreaker, ReplicaHealth
 __all__ = ["Router", "publish_from_accumulator", "publish_from_statestore"]
 
 log = get_logger("serving")
+
+
+def _probe_entry(wref, stop, interval):
+    """Probe-thread entry (the weakref thread contract,
+    docs/reliability.md): holds the Router only for one probe sweep, so
+    an abandoned router (dropped without close()) is still collectable
+    instead of being pinned forever by its own prober (the PR-12 bug
+    class)."""
+    while not stop.wait(interval):
+        router = wref()
+        if router is None:
+            return
+        router._probe_sweep()
+        del router
 
 
 class Router:
@@ -118,19 +133,21 @@ class Router:
         )
         self._stop = threading.Event()
         self._prober = threading.Thread(
-            target=self._probe_loop,
+            target=_probe_entry,
+            args=(weakref.ref(self), self._stop, self._probe_interval),
             name=f"{rpc.get_name()}-{service}-probe", daemon=True,
         )
         self._prober.start()
 
     # -- health probing ------------------------------------------------------
 
-    def _probe_loop(self):
-        while not self._stop.wait(self._probe_interval):
-            for name, h in list(self._health.items()):
-                if self._closed:
-                    return
-                self._probe_one(name, h)
+    def _probe_sweep(self):
+        """One probe pass over the fleet; driven by :func:`_probe_entry`
+        so the prober never pins ``self`` across the interval wait."""
+        for name, h in list(self._health.items()):
+            if self._closed:
+                return
+            self._probe_one(name, h)
 
     def _probe_one(self, name: str, h: ReplicaHealth):
         try:

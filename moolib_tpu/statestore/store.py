@@ -765,6 +765,7 @@ class Replicator:
         self.published: Dict[int, Dict[str, bool]] = {}
         self._stop = threading.Event()
         self._wake = threading.Event()
+        self._replicator_closed = False
         accumulator.set_durability_hook(self._on_version)
         self._thread = threading.Thread(
             target=_replicator_entry, args=(weakref.ref(self),),
@@ -835,6 +836,9 @@ class Replicator:
         return ring[:k]
 
     def close(self) -> None:
+        if self._replicator_closed:
+            return
+        self._replicator_closed = True
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=5)

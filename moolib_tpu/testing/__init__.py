@@ -9,10 +9,12 @@ never pays for (or accidentally enables) chaos machinery; see
 from .chaos import (ChaosNet, Event, FaultPlan, ProcChaos, ProcFaultPlan,
                     ResourceChaos, ResourceFaultPlan)
 from .locktrace import LockOrderViolation, LockTrace
+from .restrack import ResourceLeak, ResourceTracker
 
 __all__ = ["ChaosNet", "Event", "FaultPlan", "LockOrderViolation",
            "LockTrace", "ProcChaos", "ProcFaultPlan", "ResourceChaos",
-           "ResourceFaultPlan", "SCENARIOS"]
+           "ResourceFaultPlan", "ResourceLeak", "ResourceTracker",
+           "SCENARIOS"]
 
 
 def __getattr__(name):

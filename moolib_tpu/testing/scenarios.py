@@ -16,6 +16,7 @@ import asyncio
 import concurrent.futures
 import threading
 import time
+import weakref
 from typing import Dict
 
 import numpy as np
@@ -49,6 +50,20 @@ __all__ = [
 ]
 
 
+def _minicluster_entry(ref: "weakref.ref[MiniCluster]") -> None:
+    """Module-level broker-pump target holding only a weakref between
+    ticks, so an abandoned cluster can still be GC'd (lifelint
+    thread-pins-self)."""
+    while True:
+        self = ref()
+        if self is None or self._stop.is_set():
+            return
+        for b in list(self.brokers):
+            b.update()
+        del self  # do not pin across the sleep
+        time.sleep(0.05)
+
+
 class MiniCluster:
     """Broker + member peers, all in-process over loopback. With
     ``standby=True`` a second (idle) broker peer is also started and
@@ -74,15 +89,12 @@ class MiniCluster:
         self.brokers = [b for b in (self.broker, self.standby)
                         if b is not None]
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=_minicluster_entry, args=(weakref.ref(self),), daemon=True
+        )
         self._thread.start()
         self.clients = []
-
-    def _loop(self):
-        while not self._stop.is_set():
-            for b in list(self.brokers):
-                b.update()
-            time.sleep(0.05)
 
     def spawn(self, name: str, group: str = "g", timeout: float = 4.0):
         rpc = Rpc(name)
@@ -105,11 +117,17 @@ class MiniCluster:
         self.broker_rpc.close()
 
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         self._thread.join(timeout=5)
         for rpc, g in self.clients:
             g.close()
             rpc.close()
+        self.broker.close()
+        if self.standby is not None:
+            self.standby.close()
         self.broker_rpc.close()
         if self.standby_rpc is not None:
             self.standby_rpc.close()

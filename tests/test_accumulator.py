@@ -584,3 +584,29 @@ def test_mixed_quorum_config_never_writes_off(cluster):
         stats = a.get_gradient_stats()
         assert stats["quorum_rejected"] == 0, stats
         assert stats["straggler_writeoffs"] == 0, stats
+
+
+def test_close_releases_registrations_and_is_idempotent(cluster):
+    """Lifelint pin (ISSUE 16): close() must undefine the
+    AccumulatorService endpoints and unregister every gauge series —
+    before the fix the endpoint closures (bound methods) kept the closed
+    Accumulator reachable from the Rpc and dispatchable — and a second
+    close() must be a no-op (the idempotence contract)."""
+    rpc, g = cluster.spawn("closer")
+    acc = Accumulator(rpc, group=g, virtual_batch_size=8)
+    reg = rpc.telemetry.registry
+    assert reg.value("acc_model_version") is not None
+    assert rpc.defined("AccumulatorService::requestState")
+    assert rpc.defined("AccumulatorService::pushState")
+
+    acc.close()
+    assert reg.value("acc_model_version") is None
+    assert not rpc.defined("AccumulatorService::requestState")
+    assert not rpc.defined("AccumulatorService::pushState")
+    acc.close()  # idempotent: the second call must not double-release
+
+    # The identity is genuinely free again: a successor registers the
+    # same endpoints/gauges on the same rpc without collision.
+    acc2 = Accumulator(rpc, group=g, virtual_batch_size=8)
+    assert rpc.defined("AccumulatorService::requestState")
+    acc2.close()

@@ -79,11 +79,14 @@ def test_time_chained_protocol():
     assert float(jnp.sum(out[0])) > 64.0  # iterations actually applied
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(
     os.environ.get("MOOLIB_SKIP_REHEARSAL") == "1",
     reason="rehearsal is several minutes of subprocess compiles; "
     "MOOLIB_SKIP_REHEARSAL=1 opts out for quick dev iterations "
-    "(CI/driver runs keep it on — it protects the one live TPU window)",
+    "(CI runs it as its own named ci_check.sh stage — it protects the "
+    "one live TPU window; the ~400-500s cost no longer fits the tier-1 "
+    "870s window on a 1-core container, see ROADMAP operational debt)",
 )
 def test_chip_session_rehearsal_writes_all_artifacts(tmp_path):
     """VERDICT r4 #1: fake a tunnel window on CPU and assert the full

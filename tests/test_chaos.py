@@ -18,6 +18,7 @@ guarantees under injected faults:
 
 import threading
 import time
+import weakref
 
 import numpy as np
 import pytest
@@ -26,7 +27,7 @@ from moolib_tpu.parallel import Accumulator
 from moolib_tpu.rpc import Rpc, RpcError
 from moolib_tpu.rpc.broker import Broker
 from moolib_tpu.testing.chaos import ChaosNet, FaultPlan
-from test_group import Cluster
+from test_group import Cluster, _broker_pump
 
 
 @pytest.fixture
@@ -382,7 +383,9 @@ def test_chaos_broker_restart_accumulator_resyncs(cluster):
     cluster.broker_rpc = new_rpc
     cluster.broker = Broker(new_rpc)
     cluster._stop = threading.Event()
-    cluster._thread = threading.Thread(target=cluster._loop, daemon=True)
+    cluster._thread = threading.Thread(
+        target=_broker_pump, args=(weakref.ref(cluster),), daemon=True
+    )
     cluster._thread.start()
 
     # Peers rejoin (ping-gate watchdog keeps rejoin prompt; explicit

@@ -8,6 +8,7 @@ directly; the stats allreduce runs a real in-process broker + 3 peers.
 
 import os
 import threading
+import weakref
 import time
 
 import numpy as np
@@ -79,6 +80,18 @@ def test_checkpoint_bad_file(tmp_path):
         load_checkpoint(str(p))
 
 
+def _broker_pump(ref):
+    """Module-level thread target holding only a weakref between ticks
+    (lifelint thread-pins-self)."""
+    while True:
+        self = ref()
+        if self is None or self._stop.is_set():
+            return
+        self.broker.update()
+        del self
+        time.sleep(0.05)
+
+
 class _MiniCluster:
     def __init__(self, n):
         self.broker_rpc = Rpc("broker")
@@ -86,7 +99,10 @@ class _MiniCluster:
         addr = self.broker_rpc.debug_info()["listen"][0]
         self.broker = Broker(self.broker_rpc)
         self._stop = threading.Event()
-        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._closed = False
+        self._t = threading.Thread(
+            target=_broker_pump, args=(weakref.ref(self),), daemon=True
+        )
         self._t.start()
         self.peers = []
         for i in range(n):
@@ -106,12 +122,10 @@ class _MiniCluster:
             time.sleep(0.02)
         raise TimeoutError("group never stabilized")
 
-    def _loop(self):
-        while not self._stop.is_set():
-            self.broker.update()
-            time.sleep(0.05)
-
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         self._t.join(timeout=5)
         for rpc, g in self.peers:

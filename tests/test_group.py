@@ -4,6 +4,7 @@ test/test_group.py, test/unit/test_broker.py)."""
 
 import concurrent.futures
 import threading
+import weakref
 import time
 
 import numpy as np
@@ -12,6 +13,18 @@ import pytest
 from moolib_tpu.rpc import Rpc, RpcError
 from moolib_tpu.rpc.broker import Broker
 from moolib_tpu.rpc.group import Group
+
+
+def _broker_pump(ref):
+    """Module-level thread target holding only a weakref between ticks
+    (lifelint thread-pins-self)."""
+    while True:
+        self = ref()
+        if self is None or self._stop.is_set():
+            return
+        self.broker.update()
+        del self
+        time.sleep(0.05)
 
 
 class Cluster:
@@ -23,14 +36,12 @@ class Cluster:
         self.addr = self.broker_rpc.debug_info()["listen"][0]
         self.broker = Broker(self.broker_rpc)
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._closed = False
+        self._thread = threading.Thread(
+            target=_broker_pump, args=(weakref.ref(self),), daemon=True
+        )
         self._thread.start()
         self.clients = []
-
-    def _loop(self):
-        while not self._stop.is_set():
-            self.broker.update()
-            time.sleep(0.05)
 
     def spawn(self, name, group="g"):
         rpc = Rpc(name)
@@ -61,6 +72,9 @@ class Cluster:
         raise TimeoutError(f"group {group} never stabilized at {n} members")
 
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         self._thread.join(timeout=5)
         for rpc, g in self.clients:
@@ -300,7 +314,9 @@ def test_broker_restart_group_recovers(cluster):
     cluster.broker_rpc = new_rpc
     cluster.broker = Broker(new_rpc)
     cluster._stop = threading.Event()
-    cluster._thread = threading.Thread(target=cluster._loop, daemon=True)
+    cluster._thread = threading.Thread(
+        target=_broker_pump, args=(weakref.ref(cluster),), daemon=True
+    )
     cluster._thread.start()
 
     # Peers re-register via pings; the new epoch re-forms with all 3.

@@ -1635,14 +1635,24 @@ def test_wire_cross_module_endpoint_resolution(tmp_path):
     pkg = tmp_path / "pkg"
     pkg.mkdir()
     (pkg / "__init__.py").write_text("")
+    # The close() keeps the fixture lifecycle-clean (lifelint would flag
+    # an __init__ define with no matching undefine) — and pins that the
+    # f-string registration pattern pairs with a literal undefine.
     (pkg / "server.py").write_text(textwrap.dedent(
         """
         class Server:
             def __init__(self, rpc, name):
+                self.rpc = rpc
                 rpc.define(f"{name}::go", self._go)
 
             def _go(self, a, b):
                 return a + b
+
+            def close(self):
+                if self._closed:
+                    return
+                self._closed = True
+                self.rpc.undefine("svc::go")
         """
     ))
     (pkg / "client.py").write_text(textwrap.dedent(
@@ -2393,3 +2403,421 @@ def test_guarded_jit_counts_static_scalar_storm():
 def test_recompile_budget_rejects_unguardable():
     with pytest.raises(TypeError):
         recompile_budget(lambda x: x)
+
+
+# -- rules: lifelint (resource lifecycle / shutdown paths) --------------------
+
+
+_LIFE_RULES = [
+    "lifecycle-bare-suppression", "resource-no-release-path",
+    "thread-pins-self", "del-heavy-work", "close-not-idempotent",
+    "registration-outlives-owner",
+]
+
+
+def _lint_life(src, only=None):
+    return _lint(src, only=only or _LIFE_RULES)
+
+
+def test_life_no_release_path_flagged_and_transitive_release_clean():
+    """The canonical leak: a started thread held on self that no close()
+    path ever joins. The release may live in a private helper — the rule
+    follows class-local calls from close()."""
+    violation = """
+    import threading
+
+    def _pump(ref):
+        pass
+
+    class Pump:
+        def __init__(self):
+            self._t = threading.Thread(target=_pump, args=(None,))
+            self._t.start()
+
+        def close(self):
+            self._stopping = True
+    """
+    findings = _lint_life(violation, only=["resource-no-release-path"])
+    assert _rules_of(findings) == ["resource-no-release-path"]
+    assert "self._t" in findings[0].message
+    assert "leaks past shutdown" in findings[0].message
+
+    clean = violation.replace(
+        "        def close(self):\n            self._stopping = True",
+        "        def close(self):\n            self._halt()\n\n"
+        "        def _halt(self):\n            self._t.join()",
+    )
+    assert _lint_life(clean, only=["resource-no-release-path"]) == []
+
+
+def test_life_no_release_missing_close_and_unstarted_thread():
+    """No close() at all gets the sharper message; a thread that is never
+    start()ed holds no OS resource and is not a finding."""
+    src = """
+    import threading
+
+    def _pump(ref):
+        pass
+
+    class NoClose:
+        def __init__(self):
+            self._t = threading.Thread(target=_pump, args=(None,))
+            self._t.start()
+
+    class Lazy:
+        def __init__(self):
+            self._t = threading.Thread(target=_pump, args=(None,))
+    """
+    findings = _lint_life(src, only=["resource-no-release-path"])
+    assert _rules_of(findings) == ["resource-no-release-path"]
+    assert "has no close()" in findings[0].message
+    assert "NoClose" in findings[0].message
+
+
+def test_life_no_release_open_handle_and_container_aggregation():
+    """open() handles are tracked; releasing a container releases the
+    resources it aggregates (`for p in self._pools: p.shutdown()` — the
+    MiniCluster broker-list shape)."""
+    violation = """
+    class Writer:
+        def __init__(self, path):
+            self._f = open(path, "w")
+
+        def close(self):
+            pass
+    """
+    findings = _lint_life(violation, only=["resource-no-release-path"])
+    assert _rules_of(findings) == ["resource-no-release-path"]
+    assert "file handle" in findings[0].message
+    clean = violation.replace(
+        "        def close(self):\n            pass",
+        "        def close(self):\n            self._f.close()",
+    )
+    assert _lint_life(clean, only=["resource-no-release-path"]) == []
+
+    aggregated = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    class Fleet:
+        def __init__(self):
+            self._pool = ThreadPoolExecutor(1)
+            self._pools = [self._pool]
+
+        def close(self):
+            for p in self._pools:
+                p.shutdown()
+    """
+    assert _lint_life(aggregated, only=["resource-no-release-path"]) == []
+    leaky = aggregated.replace(
+        "            for p in self._pools:\n                p.shutdown()",
+        "            pass",
+    )
+    assert _rules_of(
+        _lint_life(leaky, only=["resource-no-release-path"])
+    ) == ["resource-no-release-path"]
+
+
+def test_life_thread_pins_self_flagged_and_weakref_entry_clean():
+    """Thread(target=self.m) / executor.submit(self.m) stored on self pin
+    the owner (the PR-12 EnvPool bug); the module-entry + weakref
+    convention is the clean shape."""
+    violation = """
+    import threading
+
+    class P:
+        def __init__(self, pool):
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._fut = pool.submit(self._work)
+
+        def _loop(self):
+            pass
+
+        def _work(self):
+            pass
+    """
+    findings = _lint_life(violation, only=["thread-pins-self"])
+    assert _rules_of(findings) == ["thread-pins-self"] * 2
+    msgs = " | ".join(f.message for f in findings)
+    assert "self._loop" in msgs and "self._work" in msgs
+    assert "weakref" in findings[0].message
+
+    clean = """
+    import threading
+    import weakref
+
+    def _entry(ref):
+        pass
+
+    class P:
+        def __init__(self):
+            self._t = threading.Thread(
+                target=_entry, args=(weakref.ref(self),), daemon=True
+            )
+    """
+    assert _lint_life(clean, only=["thread-pins-self"]) == []
+
+
+def test_life_thread_pins_self_lambda_closure_flagged():
+    src = """
+    import threading
+
+    class L:
+        def __init__(self):
+            self._t = threading.Thread(target=lambda: self.run())
+
+        def run(self):
+            pass
+    """
+    findings = _lint_life(src, only=["thread-pins-self"])
+    assert _rules_of(findings) == ["thread-pins-self"]
+    assert "lambda closing over self" in findings[0].message
+
+
+def test_life_del_heavy_work_flagged_and_flagfip_clean():
+    """__del__ taking a lock (directly, or one class-local call away) is
+    the GC-deadlock class locktrace caught; a flag flip is fine."""
+    violation = """
+    import threading
+
+    class D:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def __del__(self):
+            with self._lock:
+                pass
+    """
+    findings = _lint_life(violation, only=["del-heavy-work"])
+    assert _rules_of(findings) == ["del-heavy-work"]
+    assert "_lock" in findings[0].message
+
+    one_hop = """
+    class E:
+        def __del__(self):
+            self.close()
+
+        def close(self):
+            self._t.join()
+    """
+    findings = _lint_life(one_hop, only=["del-heavy-work"])
+    assert _rules_of(findings) == ["del-heavy-work"]
+    assert "calls self.close()" in findings[0].message
+
+    clean = """
+    class F:
+        def __del__(self):
+            self._closed = True
+    """
+    assert _lint_life(clean, only=["del-heavy-work"]) == []
+
+
+def test_life_close_not_idempotent_flagged_latch_and_guard_clean():
+    """close() re-running one-shot effects (join/unlink/...) without a
+    latch or per-resource guard raises on the second call; both the
+    `if self._closed: return` latch and the None-check guard are clean."""
+    violation = """
+    class C:
+        def close(self):
+            self._t.join()
+            self._shm.unlink()
+    """
+    findings = _lint_life(violation, only=["close-not-idempotent"])
+    assert _rules_of(findings) == ["close-not-idempotent"]
+    assert "join" in findings[0].message and "unlink" in findings[0].message
+
+    latched = """
+    class C:
+        def close(self):
+            if self._closed:
+                return
+            self._closed = True
+            self._t.join()
+            self._shm.unlink()
+    """
+    assert _lint_life(latched, only=["close-not-idempotent"]) == []
+
+    guarded = """
+    class C:
+        def close(self):
+            t = self._t
+            if t is not None:
+                t.join()
+            self._t = None
+    """
+    assert _lint_life(guarded, only=["close-not-idempotent"]) == []
+
+
+def test_life_registration_outlives_owner_flagged_and_clean():
+    """gauge/endpoint registrations in __init__ with no matching
+    unregister/undefine in the class (PR-5/PR-8 family)."""
+    violation = """
+    class Svc:
+        def __init__(self, rpc, reg):
+            rpc.define("svc.poke", self._handle)
+            reg.gauge_fn("svc_up", lambda: 1.0)
+
+        def _handle(self):
+            pass
+    """
+    findings = _lint_life(violation, only=["registration-outlives-owner"])
+    assert _rules_of(findings) == ["registration-outlives-owner"] * 2
+    msgs = " | ".join(f.message for f in findings)
+    assert "svc.poke" in msgs and "svc_up" in msgs
+    assert "outlives the owner" in msgs
+
+    clean = violation.replace(
+        "        def _handle(self):\n            pass",
+        "        def _handle(self):\n            pass\n\n"
+        "        def close(self):\n"
+        "            self.rpc.undefine(\"svc.poke\")\n"
+        "            self.reg.unregister(\"svc_up\")",
+    )
+    assert _lint_life(clean, only=["registration-outlives-owner"]) == []
+
+
+def test_life_registration_loop_unregister_and_closed_receiver_silence():
+    """Silence bias: an unresolvable unregister name (`for name in
+    self._names: reg.unregister(name)` — the Accumulator close() shape)
+    silences its kind, and a receiver the class itself closes takes its
+    registrations down with it."""
+    loop_unregister = """
+    class A:
+        def __init__(self, reg):
+            self._names = ("acc_a", "acc_b")
+            reg.gauge_fn("acc_a", lambda: 1.0)
+            reg.gauge_fn("acc_b", lambda: 2.0)
+
+        def close(self):
+            for name in self._names:
+                self.reg.unregister(name)
+    """
+    assert _lint_life(
+        loop_unregister, only=["registration-outlives-owner"]
+    ) == []
+
+    closed_receiver = """
+    class Owner:
+        def __init__(self, make_rpc):
+            self._rpc = make_rpc()
+            self._rpc.define("owner.ping", self._h)
+
+        def _h(self):
+            pass
+
+        def close(self):
+            self._rpc.close()
+    """
+    assert _lint_life(
+        closed_receiver, only=["registration-outlives-owner"]
+    ) == []
+
+
+def test_life_bare_suppression_flagged_reasoned_suppresses():
+    """The lifelint grammar mirrors racelint's: a bare
+    `# lifelint: intentional` suppresses nothing and is itself flagged;
+    with a reason it silences the lifecycle rules on that line."""
+    bare = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._t = threading.Thread(target=self._loop)  # lifelint: intentional
+
+        def _loop(self):
+            pass
+    """
+    rules = sorted(_rules_of(_lint_life(bare)))
+    assert rules == ["lifecycle-bare-suppression", "thread-pins-self"]
+
+    reasoned = bare.replace(
+        "# lifelint: intentional",
+        "# lifelint: intentional -- rehearsal-only thread; the harness "
+        "joins it in teardown",
+    )
+    assert _lint_life(reasoned) == []
+
+
+def test_life_rules_registered_in_default_suite():
+    """The family runs without --only and all six rules are registered."""
+    from moolib_tpu.analysis.engine import all_rules
+
+    names = {r.name for r in all_rules()}
+    assert set(_LIFE_RULES) <= names
+    src = """
+    import threading
+
+    class P:
+        def __init__(self):
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            pass
+    """
+    assert "thread-pins-self" in {f.rule for f in _lint(src)}
+
+
+# -- result cache -------------------------------------------------------------
+
+
+def test_lint_cache_hit_miss_and_content_invalidation(tmp_path):
+    """Second identical run is all hits with identical findings; any
+    content change opens a fresh project section (all misses again) —
+    the soundness property that lets the interprocedural rules cache."""
+    f = tmp_path / "m.py"
+    f.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    cache = tmp_path / "cache.json"
+
+    stats = {}
+    first = lint_paths([f], root=tmp_path, cache_path=cache,
+                       cache_stats=stats)
+    assert first, "fixture must produce at least one finding"
+    assert stats == {"hits": 0, "misses": 1}
+
+    stats = {}
+    second = lint_paths([f], root=tmp_path, cache_path=cache,
+                        cache_stats=stats)
+    assert stats == {"hits": 1, "misses": 0}
+    assert [x.to_dict() for x in second] == [x.to_dict() for x in first]
+
+    f.write_text(f.read_text() + "\nx = 1\n")
+    stats = {}
+    third = lint_paths([f], root=tmp_path, cache_path=cache,
+                       cache_stats=stats)
+    assert stats == {"hits": 0, "misses": 1}
+    assert [x.to_dict() for x in third] == [x.to_dict() for x in first]
+
+
+def test_lint_cache_corrupt_file_is_ignored(tmp_path):
+    f = tmp_path / "m.py"
+    f.write_text("x = 1\n")
+    cache = tmp_path / "cache.json"
+    cache.write_text("{not json")
+    stats = {}
+    lint_paths([f], root=tmp_path, cache_path=cache, cache_stats=stats)
+    assert stats == {"hits": 0, "misses": 1}
+    # And the rewritten cache is valid for the next run.
+    stats = {}
+    lint_paths([f], root=tmp_path, cache_path=cache, cache_stats=stats)
+    assert stats == {"hits": 1, "misses": 0}
+
+
+def test_cli_cache_line_and_no_cache_opt_out(tmp_path):
+    """--rule-times reports cache hit/miss counts; --no-cache drops the
+    line entirely (and never touches the cache file)."""
+    scratch = tmp_path / "scratch.py"
+    scratch.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--rule-times", "--no-baseline",
+         "--no-cache", str(scratch)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "moolint: cache:" not in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--rule-times", "--no-baseline",
+         "--json", str(scratch)],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert set(data["cache"]) == {"hits", "misses"}

@@ -94,6 +94,14 @@ def main(argv=None):
                              "real acquires-while-holding graph, then "
                              "assert it is acyclic AND inside racelint's "
                              "static over-approximation")
+    parser.add_argument("--restrack", action="store_true",
+                        help="run under the resource tracker "
+                             "(moolib_tpu.testing.restrack): every tracked "
+                             "acquisition (threads, SharedMemory, Rpcs, "
+                             "gauge registrations) made by a scenario must "
+                             "be released by its end — lifelint's dynamic "
+                             "mirror; a leak fails the scenario with the "
+                             "acquisition-site stack")
     args = parser.parse_args(argv)
 
     # Black-box auto-capture for the whole pass: a breaker opening or a
@@ -107,6 +115,13 @@ def main(argv=None):
 
         trace = LockTrace()
         trace.activate()
+
+    tracker = None
+    if args.restrack:
+        from moolib_tpu.testing.restrack import ResourceTracker
+
+        tracker = ResourceTracker()
+        tracker.activate()
 
     if args.scenario:
         names = sorted(n for n in SCENARIOS
@@ -129,8 +144,15 @@ def main(argv=None):
         for name in names:
             seed = args.seed + 1000 * iteration + len(runs)
             t0 = time.monotonic()
+            tok = tracker.mark() if tracker is not None else 0
             try:
                 summary = SCENARIOS[name](seed)
+                if tracker is not None:
+                    # ResourceLeak is an AssertionError: a scenario that
+                    # leaks fails exactly like an invariant violation.
+                    tracker.assert_released(
+                        since=tok, what=f"{name} seed={seed}"
+                    )
                 runs.append({
                     "scenario": name, "seed": seed, "ok": True,
                     "seconds": round(time.monotonic() - t0, 2),
@@ -175,6 +197,15 @@ def main(argv=None):
         if args.smoke or (deadline is not None
                           and time.monotonic() > deadline) or not ok:
             break
+    restrack_report = None
+    if tracker is not None:
+        tracker.deactivate()
+        restrack_report = {
+            "tracked": tracker.mark(),
+            "leaked": {k: v for k, v in tracker.counts().items()},
+        }
+        print(f"restrack: {restrack_report['tracked']} tracked "
+              f"acquisition(s), leaked={restrack_report['leaked'] or 0}")
     locktrace_report = None
     if trace is not None:
         trace.deactivate()
@@ -204,6 +235,7 @@ def main(argv=None):
         "total_seconds": round(time.monotonic() - t_start, 1),
         "scenario_seconds": scenario_seconds,
         **({"locktrace": locktrace_report} if locktrace_report else {}),
+        **({"restrack": restrack_report} if restrack_report else {}),
     }))
     return 0 if ok else 1
 

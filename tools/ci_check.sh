@@ -82,7 +82,12 @@ echo "== chaos + serving smoke =="
 # (testing/locktrace.py): the OBSERVED acquires-while-holding graph must
 # stay acyclic (no lock-order inversion ever executed) and inside
 # racelint's static over-approximation (docs/analysis.md).
-env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke --locktrace
+# --restrack runs it under the resource tracker too (testing/restrack.py,
+# lifelint's dynamic mirror): every thread/SharedMemory/Rpc/gauge
+# acquisition a scenario makes must be released by its end, so the
+# 15-scenario pass doubles as a leak soak — a leak fails the scenario
+# with the acquisition-site stack.
+env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke --locktrace --restrack
 
 # shm transport interop tests (same-host selection, cross-host refusal,
 # MOOLIB_TPU_SHM=0 interop, /dev/shm leak hygiene, zero-copy receive):
@@ -117,6 +122,16 @@ echo "== incident smoke =="
 # scenario failure writes a bundle into incidents/ and prints its path
 # next to the seed-replay command (upload incidents/ as a CI artifact).
 timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/incident_report.py --smoke
+
+echo "== chip_session rehearsal =="
+# The full probe -> stage-run -> artifact-write rehearsal (400-500s of
+# subprocess compiles on this class of container) no longer fits inside
+# tier-1's 870s window, so it is `slow`-marked out of the pytest sweep
+# below and runs here as its own named stage — coverage is unchanged,
+# only the budget it bills against moved. MOOLIB_SKIP_REHEARSAL=1 still
+# opts out for quick local iterations.
+timeout -k 10 800 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_bench_tools.py -q -m slow -p no:cacheprovider
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

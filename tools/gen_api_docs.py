@@ -72,6 +72,9 @@ MODULES = [
      "the tier-1 suite and the CI soak runner"),
     ("moolib_tpu.testing.locktrace", "dynamic lock-order tracer: "
      "instrumented locks, observed acquires-while-holding graph"),
+    ("moolib_tpu.testing.restrack", "dynamic resource-leak tracker: "
+     "acquisition/release pairing for threads, shm, Rpcs, gauges "
+     "(lifelint's runtime mirror)"),
     ("moolib_tpu.serving", "fault-tolerant serving tier: replicated "
      "inference behind a load-aware router"),
     ("moolib_tpu.serving.admission", "bounded admission queues, "
@@ -117,8 +120,8 @@ MODULES = [
     ("moolib_tpu.utils.flops", "analytic FLOPs accounting / MFU"),
     ("moolib_tpu.utils.nest", "nested-structure utilities"),
     ("moolib_tpu.analysis", "moolint: async-RPC safety, JAX trace hygiene, "
-     "sharding/collective consistency + RPC round-balance static analysis "
-     "(tier-1 enforced)"),
+     "sharding/collective consistency, RPC round-balance, race/lock-order "
+     "+ resource-lifecycle static analysis (tier-1 enforced)"),
     ("moolib_tpu.bench.harness", "perfwatch harness: timing protocol + "
      "unified result schema"),
     ("moolib_tpu.bench.suite", "CPU-proxy perf suite (runs on every PR, "

@@ -25,6 +25,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 from moolib_tpu.analysis.engine import (  # noqa: E402
+    DEFAULT_CACHE,
     LintError,
     all_rules,
     diff_against_baseline,
@@ -64,11 +65,20 @@ def main(argv=None) -> int:
                     help="run only these rules (repeatable / comma lists; "
                          "fnmatch globs like 'race-*' select a family)")
     ap.add_argument("--rule-times", action="store_true",
-                    help="report per-rule wall-time for the lint run; "
-                         "with --baseline-stats, profiles the suite over "
-                         "the default package tree (honors --only) so "
-                         "the now-7-family suite can be profiled "
-                         "selectively in CI and locally")
+                    help="report per-rule wall-time for the lint run "
+                         "(plus result-cache hit/miss counts); with "
+                         "--baseline-stats, profiles the suite over "
+                         "the default package tree (honors --only, "
+                         "always uncached) so the now-8-family suite "
+                         "can be profiled selectively in CI and locally")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the per-file result cache (stored "
+                         f"beside the baselines: {DEFAULT_CACHE.name}; "
+                         "content-hash keyed per file inside a "
+                         "whole-project-hash section, so any edit "
+                         "anywhere re-lints everything and the cache "
+                         "can never go stale on the interprocedural "
+                         "rules)")
     ap.add_argument("--format", choices=("text", "json", "gha"),
                     default=None, dest="fmt",
                     help="output format: text (default), json "
@@ -117,9 +127,13 @@ def main(argv=None) -> int:
         only = [r for chunk in args.only for r in chunk.split(",") if r]
 
     timings = {} if args.rule_times else None
+    cache_stats = None if args.no_cache else {}
     try:
-        findings = lint_paths(paths, root=REPO_ROOT, only=only,
-                              timings=timings)
+        findings = lint_paths(
+            paths, root=REPO_ROOT, only=only, timings=timings,
+            cache_path=None if args.no_cache else DEFAULT_CACHE,
+            cache_stats=cache_stats,
+        )
     except LintError as e:
         print(f"moolint: error: {e}", file=sys.stderr)
         return 2
@@ -164,6 +178,8 @@ def main(argv=None) -> int:
             out["rule_seconds"] = {
                 k: round(v, 4) for k, v in timings.items()
             }
+            if cache_stats is not None:
+                out["cache"] = cache_stats
         print(json.dumps(out, indent=1))
     else:
         for f in new:
@@ -186,6 +202,10 @@ def main(argv=None) -> int:
         )
         if timings is not None:
             _print_rule_times(timings)
+            if cache_stats is not None:
+                print(f"moolint: cache: {cache_stats.get('hits', 0)} "
+                      f"hit(s), {cache_stats.get('misses', 0)} miss(es) "
+                      f"({DEFAULT_CACHE.name}; --no-cache disables)")
     return 1 if new else 0
 
 
