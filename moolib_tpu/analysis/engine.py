@@ -400,6 +400,11 @@ class Rule:
 
     name: str = ""
     description: str = ""
+    #: Family tag for ``--only`` globbing: a pattern also matches
+    #: ``"<family>-<name>"``, so ``hot-*`` selects the whole hotlint
+    #: family even though its rule names keep their descriptive spellings
+    #: (host-transfer-in-steploop etc.). Empty = name-only matching.
+    family: str = ""
 
     def check(self, ctx: "ModuleContext") -> Iterable[Finding]:
         raise NotImplementedError
@@ -648,31 +653,39 @@ def all_rules() -> List[Rule]:
     sharding/collective consistency + RPC round/counter balance + RPC
     wire-surface consistency + benchmark timing hygiene + guarded-field
     / lock-order race analysis + resource-lifecycle / shutdown-path
-    analysis)."""
-    from . import (rules_async, rules_bench, rules_jax, rules_lifecycle,
-                   rules_protocol, rules_race, rules_sharding, rules_wire)
+    analysis + hot-path device/host discipline)."""
+    from . import (rules_async, rules_bench, rules_hot, rules_jax,
+                   rules_lifecycle, rules_protocol, rules_race,
+                   rules_sharding, rules_wire)
 
     return [
         cls()
         for cls in (rules_async.RULES + rules_jax.RULES
                     + rules_sharding.RULES + rules_protocol.RULES
                     + rules_wire.RULES + rules_bench.RULES
-                    + rules_race.RULES + rules_lifecycle.RULES)
+                    + rules_race.RULES + rules_lifecycle.RULES
+                    + rules_hot.RULES)
     ]
 
 
 def _select_rules(rules: Optional[Sequence[Rule]],
                   only: Optional[Sequence[str]]) -> List[Rule]:
     """``only`` entries are rule names or fnmatch globs (``race-*``
-    selects the whole family); a pattern matching nothing is an error,
-    not a silently-empty run."""
+    selects the whole family); a pattern also matches a rule's
+    family-qualified name (:attr:`Rule.family` + ``-`` + name), so
+    ``hot-*`` selects every hotlint rule. A pattern matching nothing is
+    an error, not a silently-empty run."""
     selected = list(rules) if rules is not None else all_rules()
     if only:
-        names = {r.name for r in selected}
         wanted: set = set()
         unknown: List[str] = []
         for pat in only:
-            hits = {n for n in names if fnmatch.fnmatchcase(n, pat)}
+            hits = {
+                r.name for r in selected
+                if fnmatch.fnmatchcase(r.name, pat)
+                or (r.family
+                    and fnmatch.fnmatchcase(f"{r.family}-{r.name}", pat))
+            }
             if not hits:
                 unknown.append(pat)
             wanted |= hits

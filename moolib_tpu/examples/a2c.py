@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import threading
 from typing import List, Optional
 
 import numpy as np
@@ -200,17 +201,28 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
         net.apply, config=loss_cfg, loss_fn=a2c_loss,
         grad_scale=float(cfg.batch_size),
     )
-    apply_step = make_apply_step(optimizer, donate=False)
+    # apply_step donates its state argument: the previous generation's
+    # buffers die the moment the update is dispatched, so XLA updates in
+    # place instead of holding both generations of params + opt_state.
+    # The cost: get_state (Accumulator RPC threads serving requestState)
+    # reads the same `state` binding, so the full-model device_get and
+    # the apply+rebind must be mutually exclusive — state_lock below.
+    # Lock order is always accumulator._lock -> state_lock (via the
+    # callbacks); nothing under state_lock takes the accumulator's.
+    apply_step = make_apply_step(optimizer, donate=True)
+    state_lock = threading.Lock()
 
     def get_state():
-        return {
-            "state": jax.device_get(state),
-            "model_version": accumulator.model_version,
-        }
+        with state_lock:
+            return {
+                "state": jax.device_get(state),
+                "model_version": accumulator.model_version,
+            }
 
     def set_state(payload):
         nonlocal state
-        state = jax.tree_util.tree_map(jnp.asarray, payload["state"])
+        with state_lock:
+            state = jax.tree_util.tree_map(jnp.asarray, payload["state"])
 
     accumulator = moolib_tpu.Accumulator(
         rpc,
@@ -304,8 +316,8 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
                     jnp.asarray(out["done"]),
                     bs.core_state,
                 )
-                a = np.asarray(a)
-                bs.record_action(a, np.asarray(logits), core)
+                a = np.asarray(a)  # hotlint: sync -- actions must reach the host NOW to feed the envpool slab: the Sebulba actor-loop boundary, not a stray sync
+                bs.record_action(a, np.asarray(logits), core)  # hotlint: sync -- behavior logits ride the host-side unroll buffer with the action that produced them
                 actions[i][:] = a
                 futures[i] = pool.step(i, actions[i])
                 env_steps += cfg.batch_size
@@ -341,10 +353,14 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
                         stats["skips"] += 1
                 if accumulator.has_gradients():
                     mean_grads, _count = accumulator.result_gradients()
-                    state = apply_step(
-                        state,
-                        jax.tree_util.tree_map(jnp.asarray, mean_grads),
-                    )
+                    # Atomic with the rebind: a get_state on an RPC thread
+                    # between the donating dispatch and the rebind would
+                    # device_get buffers the donation just invalidated.
+                    with state_lock:
+                        state = apply_step(
+                            state,
+                            jax.tree_util.tree_map(jnp.asarray, mean_grads),
+                        )
                     accumulator.zero_gradients()
                     stats["updates"] += 1
 

@@ -102,6 +102,13 @@ def run_learner(cfg: RemoteConfig, listen: str = "127.0.0.1:0",
     )
     state = make_train_state(params, opt)
     state_lock = threading.Lock()
+    # donate=False is deliberate, not an oversight: infer() (RPC threads)
+    # snapshots `state.params` under state_lock but runs _infer AFTER
+    # releasing it, concurrently with the train loop's step_fn — donating
+    # position 0 would invalidate exactly the param buffers an in-flight
+    # inference is still reading. The a2c/vtrace learners donate instead
+    # because their only cross-thread readers (get_state) hold the lock
+    # for the whole read.
     step_fn = make_impala_train_step(net.apply, opt, ImpalaConfig(),
                                      donate=False)
 

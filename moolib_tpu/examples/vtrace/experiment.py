@@ -26,8 +26,10 @@ number of peers with ``--broker tcp://HOST:4431``.
 from __future__ import annotations
 
 import argparse
+import concurrent.futures
 import dataclasses
 import os
+import threading
 import time
 from typing import List, Optional
 
@@ -253,15 +255,26 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
         learn_apply, config=loss_cfg, mesh=mesh,
         grad_scale=float(cfg.learn_batch_size),
     )
-    apply_step = make_apply_step(optimizer, donate=False)
+    # apply_step donates its state argument: the previous generation's
+    # buffers die the moment the update is dispatched, so XLA updates in
+    # place instead of holding params + opt_state twice. get_state runs
+    # on Accumulator RPC threads (requestState service) against the same
+    # `state` binding, so the full-model device_get and the apply+rebind
+    # must be mutually exclusive — state_lock below. Lock order is always
+    # accumulator._lock -> state_lock; nothing under state_lock takes
+    # the accumulator's lock back.
+    apply_step = make_apply_step(optimizer, donate=True)
+    state_lock = threading.Lock()
 
     # --- elasticity / persistence ------------------------------------------
     def get_state():
-        return {"state": jax.device_get(state)}
+        with state_lock:
+            return {"state": jax.device_get(state)}
 
     def set_state(payload):
         nonlocal state
-        state = jax.tree_util.tree_map(jnp.asarray, payload["state"])
+        with state_lock:
+            state = jax.tree_util.tree_map(jnp.asarray, payload["state"])
 
     accumulator = moolib_tpu.Accumulator(
         rpc,
@@ -339,6 +352,8 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                 name=rpc.get_name(),
                 config=dataclasses.asdict(cfg),
             )
+        except concurrent.futures.CancelledError:
+            raise  # executor cancellation is control flow, not "no wandb"
         except Exception as e:
             log_fn(f"wandb disabled ({e}); logging to tsv only")
     logs: List[dict] = []
@@ -439,8 +454,8 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                     jnp.asarray(out["done"]),
                     bs.core_state,
                 )
-                a = np.asarray(a)
-                bs.record_action(a, np.asarray(logits), core)
+                a = np.asarray(a)  # hotlint: sync -- actions must reach the host NOW to feed the envpool slab: the Sebulba actor-loop boundary, not a stray sync
+                bs.record_action(a, np.asarray(logits), core)  # hotlint: sync -- behavior logits ride the host-side unroll buffer with the action that produced them
                 actions[i][:] = a
                 futures[i] = pool.step(i, actions[i])
                 env_steps += cfg.actor_batch_size
@@ -491,9 +506,14 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                     # i.e. the 0-based index of the one about to run — so
                     # the [start, stop) window captures exactly those.
                     profiler.step(int(stats["updates"].result()))
-                    state = apply_step(
-                        state, jax.tree_util.tree_map(jnp.asarray, mean_grads)
-                    )
+                    # Atomic with the rebind: a get_state on an RPC thread
+                    # between the donating dispatch and the rebind would
+                    # device_get buffers the donation just invalidated.
+                    with state_lock:
+                        state = apply_step(
+                            state,
+                            jax.tree_util.tree_map(jnp.asarray, mean_grads),
+                        )
                     accumulator.zero_gradients()
                     stats["updates"] += 1
 

@@ -8,13 +8,15 @@ never pays for (or accidentally enables) chaos machinery; see
 
 from .chaos import (ChaosNet, Event, FaultPlan, ProcChaos, ProcFaultPlan,
                     ResourceChaos, ResourceFaultPlan)
+from .hotwatch import Hotwatch, HotwatchViolation, hotwatch_enabled
 from .locktrace import LockOrderViolation, LockTrace
 from .restrack import ResourceLeak, ResourceTracker
 
-__all__ = ["ChaosNet", "Event", "FaultPlan", "LockOrderViolation",
-           "LockTrace", "ProcChaos", "ProcFaultPlan", "ResourceChaos",
+__all__ = ["ChaosNet", "Event", "FaultPlan", "Hotwatch",
+           "HotwatchViolation", "LockOrderViolation", "LockTrace",
+           "ProcChaos", "ProcFaultPlan", "ResourceChaos",
            "ResourceFaultPlan", "ResourceLeak", "ResourceTracker",
-           "SCENARIOS"]
+           "SCENARIOS", "hotwatch_enabled"]
 
 
 def __getattr__(name):

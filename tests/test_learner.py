@@ -6,6 +6,7 @@ tests (reference: test/integration/test_a2c.py asserts learning-curve
 properties; test/unit tests assert mechanism correctness).
 """
 
+import concurrent.futures
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -179,3 +180,84 @@ def test_lstm_model_trains_one_step():
     stateN = replicate_state(make_train_state(params, opt), mesh)
     stateN, metricsN = stepN(stateN, batch)
     assert np.isfinite(float(metricsN["total_loss"]))
+
+
+def test_apply_step_donated_path_matches_and_survives_get_state():
+    """Regression pin for the donated example apply path (hotlint's
+    jit-missing-donation burn-down): donate=True must produce the same
+    numerics as the non-donating step, and a locked get_state-style full
+    read concurrently with locked apply+rebind threading must never see
+    donated (deleted) buffers. On CPU donation is a no-op, so the
+    equivalence and the locking discipline are what this pins; on real
+    accelerators the same code also reuses the buffers."""
+    import threading
+
+    from moolib_tpu.learner import make_apply_step
+
+    opt = optax.sgd(0.1)
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    grads = {"w": jnp.full((4, 4), 0.5), "b": jnp.ones((4,))}
+
+    plain = make_apply_step(opt, donate=False)
+    donating = make_apply_step(opt, donate=True)
+    s_plain = make_train_state(params, opt)
+    s_don = make_train_state(params, opt)
+
+    state_lock = threading.Lock()
+    stop = threading.Event()
+    errs = []
+
+    def get_state_loop():
+        # The a2c/vtrace get_state shape: full device_get under the lock.
+        while not stop.is_set():
+            try:
+                with state_lock:
+                    jax.device_get(s_don)
+            except concurrent.futures.CancelledError as e:  # pragma: no cover
+                errs.append(e)
+                raise  # recorded for the assertion below, never swallowed
+            except Exception as e:  # pragma: no cover - failure capture
+                errs.append(e)
+                return
+
+    reader = threading.Thread(target=get_state_loop)
+    reader.start()
+    try:
+        for _ in range(20):
+            s_plain = plain(s_plain, grads)
+            with state_lock:
+                s_don = donating(s_don, grads)
+    finally:
+        stop.set()
+        reader.join(timeout=10)
+    assert not errs, errs
+    np.testing.assert_allclose(
+        np.asarray(s_plain.params["w"]), np.asarray(s_don.params["w"]),
+        rtol=1e-6,
+    )
+    assert int(s_don.step) == 20
+
+
+def test_examples_thread_state_through_donating_apply():
+    """The a2c and vtrace learners must keep the donating apply_step AND
+    the state_lock that makes it safe (get_state runs on RPC threads);
+    remote_actors must stay non-donating — its infer() reads params
+    outside the lock, concurrently with the train step."""
+    import re
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent / "moolib_tpu"
+    for rel in ("examples/a2c.py", "examples/vtrace/experiment.py"):
+        src = (root / rel).read_text()
+        assert "make_apply_step(optimizer, donate=True)" in src, rel
+        assert "state_lock = threading.Lock()" in src, rel
+        # The apply+rebind is inside the lock: `with state_lock:` with
+        # `state = apply_step(` on the following lines.
+        assert re.search(
+            r"with state_lock:\s*\n\s*state = apply_step\(", src
+        ), f"{rel}: apply+rebind must hold state_lock"
+    remote = (root / "examples/remote_actors.py").read_text()
+    assert "donate=False" in remote, (
+        "remote_actors must NOT donate: infer() reads params outside "
+        "the lock concurrently with the train step"
+    )

@@ -2821,3 +2821,350 @@ def test_cli_cache_line_and_no_cache_opt_out(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     data = json.loads(proc.stdout)
     assert set(data["cache"]) == {"hits", "misses"}
+
+
+# -- rules: hotlint (hot-path device/host discipline) -------------------------
+
+_HOT_RULES = [
+    "host-transfer-in-steploop", "jit-missing-donation",
+    "sync-in-dispatch-shadow", "device-alloc-in-steploop",
+    "python-loop-over-device-array", "hot-bare-suppression",
+]
+
+
+def _lint_hot(src, relpath="scratch.py", only=("hot-*",)):
+    return lint_source(textwrap.dedent(src), relpath, only=list(only))
+
+
+def test_hot_transfer_in_steploop_flagged_and_staged_clean():
+    """The acceptance scenario: a steady-state `.item()` in a loop that
+    dispatches a jitted step is caught statically; the staged-and-
+    drained house pattern is clean."""
+    seeded = """
+    import jax
+
+    step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+    def train(state, batches):
+        for batch in batches:
+            state, metrics = step(state, batch)
+            loss = metrics.item()
+    """
+    assert _rules_of(_lint_hot(seeded)) == ["host-transfer-in-steploop"]
+
+    staged = """
+    import jax
+
+    step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+    def train(state, batches, log_due):
+        pending = []
+        for batch in batches:
+            state, metrics = step(state, batch)
+            metrics.copy_to_host_async()
+            pending.append(metrics)
+            if log_due:
+                print(float(pending[-1]))
+    """
+    assert _lint_hot(staged) == []
+
+
+def test_hot_transfer_materializer_forms():
+    """float()/np.asarray()/f-string/str.format on a jit-result value are
+    all the same blocking D2H; taint flows through plain rebinds and
+    tuple unpacking but NOT through arbitrary calls."""
+    src = """
+    import jax
+    import numpy as np
+
+    step = jax.jit(lambda s: s)
+
+    def train(state, n, log):
+        for _ in range(n):
+            state = step(state)
+            alias = state
+            x = float(alias)
+            y = np.asarray(state)
+            log(f"loss={state}")
+            log("loss {}".format(state))
+            cooked = transform(state)   # opaque call: taint stops
+            z = cooked.tolist()
+    """
+    found = _lint_hot(src, only=["host-transfer-in-steploop"])
+    assert len(found) == 4, "\n".join(str(f) for f in found)
+
+
+def test_hot_transfer_log_boundary_exempt():
+    """Reads gated on a log/drain-cadence `if` are the drain pattern —
+    exactly where the sync belongs."""
+    src = """
+    import jax
+
+    step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+    def train(state, n, next_log, steps):
+        for _ in range(n):
+            state = step(state)
+            if steps >= next_log:
+                print(float(state))
+    """
+    assert _lint_hot(src) == []
+
+
+def test_hot_suppression_grammar():
+    """`# hotlint: sync -- <reason>` silences the line; a bare marker
+    suppresses nothing and is itself flagged (mirrors racelint)."""
+    bare = """
+    import jax
+
+    step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+    def train(state, n):
+        for _ in range(n):
+            state = step(state)
+            a = state.item()  # hotlint: sync
+    """
+    rules = sorted(_rules_of(_lint_hot(bare)))
+    assert rules == ["host-transfer-in-steploop", "hot-bare-suppression"]
+
+    reasoned = bare.replace(
+        "# hotlint: sync",
+        "# hotlint: sync -- actions must reach the host to feed the envs",
+    )
+    assert _lint_hot(reasoned) == []
+
+
+def test_hot_missing_donation_flagged_and_donated_clean():
+    seeded = """
+    import jax
+
+    def f(s, b):
+        return s
+
+    step = jax.jit(f)
+
+    def train(state, batches):
+        for batch in batches:
+            state = step(state, batch)
+    """
+    found = _lint_hot(seeded, only=["jit-missing-donation"])
+    assert _rules_of(found) == ["jit-missing-donation"]
+    assert "position 0" in found[0].message
+
+    donated = seeded.replace("jax.jit(f)",
+                             "jax.jit(f, donate_argnums=(0,))")
+    assert _lint_hot(donated, only=["jit-missing-donation"]) == []
+
+
+def test_hot_missing_donation_conditional_spec_silent():
+    """`donate_argnums=(0,) if donate else ()` is unresolvable: trust it
+    (the learner factories' shape — silence over guessing)."""
+    src = """
+    import jax
+
+    def make(donate):
+        def f(s, b):
+            return s
+        return jax.jit(f, donate_argnums=(0,) if donate else ())
+
+    step = make(True)
+
+    def train(state, batches):
+        for batch in batches:
+            state = step(state, batch)
+    """
+    assert _lint_hot(src, only=["jit-missing-donation"]) == []
+
+
+def test_hot_missing_donation_partial_shifts_positions():
+    """partial() consumes leading positions: a donated position 1 becomes
+    position 0 of the wrapper (clean); an undonated thread through the
+    wrapper is still flagged."""
+    shifted_ok = """
+    import jax
+    from functools import partial
+
+    def f(cfg, s):
+        return s
+
+    step = jax.jit(f, donate_argnums=(1,))
+
+    def train(cfg, state, batches):
+        bound = partial(step, cfg)
+        for _ in batches:
+            state = bound(state)
+    """
+    assert _lint_hot(shifted_ok, only=["jit-missing-donation"]) == []
+
+    shifted_bad = """
+    import jax
+    from functools import partial
+
+    def f(cfg, s):
+        return s
+
+    step = jax.jit(f)
+
+    def train(cfg, state, batches):
+        bound = partial(step, cfg)
+        for _ in batches:
+            state = bound(state)
+    """
+    assert _rules_of(
+        _lint_hot(shifted_bad, only=["jit-missing-donation"])
+    ) == ["jit-missing-donation"]
+
+
+def test_hot_missing_donation_alias_and_factory_resolution(tmp_path):
+    """The binding resolves through plain assignment aliases, and through
+    a factory imported from another module (one project-index hop —
+    including function-local lazy imports, the examples' shape)."""
+    (tmp_path / "factory.py").write_text(textwrap.dedent("""
+        import jax
+
+        def make_step(apply_fn):
+            def step(state, batch):
+                return state
+            return jax.jit(step)
+    """))
+    (tmp_path / "train.py").write_text(textwrap.dedent("""
+        def train(state, batches, apply_fn):
+            from factory import make_step
+
+            step = make_step(apply_fn)
+            alias = step
+            for batch in batches:
+                state = alias(state, batch)
+    """))
+    found = lint_paths([tmp_path], root=tmp_path,
+                       only=["jit-missing-donation"])
+    assert [f.rule for f in found] == ["jit-missing-donation"]
+    assert found[0].path == "train.py"
+
+
+def test_hot_sync_in_dispatch_shadow_flagged_and_clean():
+    seeded = """
+    import jax
+
+    step = jax.jit(lambda s: s)
+
+    def run(state, grads):
+        out = step(state)
+        grads.block_until_ready()
+        return step(out)
+    """
+    assert _rules_of(
+        _lint_hot(seeded, only=["sync-in-dispatch-shadow"])
+    ) == ["sync-in-dispatch-shadow"]
+
+    # Final sync after the last dispatch is the correct shape.
+    clean = """
+    import jax
+
+    step = jax.jit(lambda s: s)
+
+    def run(state):
+        out = step(state)
+        out2 = step(out)
+        out2.block_until_ready()
+        return out2
+    """
+    assert _lint_hot(clean, only=["sync-in-dispatch-shadow"]) == []
+
+
+def test_hot_sync_in_dispatch_shadow_bench_paths_exempt():
+    """Timing protocols sync between dispatches by design; bench-scoped
+    files (the bench-wallclock scope) are exempt."""
+    src = """
+    import jax
+
+    step = jax.jit(lambda s: s)
+
+    def measure(state):
+        out = step(state)
+        out.block_until_ready()
+        return step(out)
+    """
+    assert _lint_hot(src, relpath="tools/bench_thing.py",
+                     only=["sync-in-dispatch-shadow"]) == []
+    assert _rules_of(
+        _lint_hot(src, relpath="moolib_tpu/learner.py",
+                  only=["sync-in-dispatch-shadow"])
+    ) == ["sync-in-dispatch-shadow"]
+
+
+def test_hot_device_alloc_in_steploop_invariant_flagged():
+    seeded = """
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(lambda s, m: s)
+
+    def train(state, n):
+        for _ in range(n):
+            mask = jnp.zeros((4, 4))
+            state = step(state, mask)
+    """
+    assert _rules_of(
+        _lint_hot(seeded, only=["device-alloc-in-steploop"])
+    ) == ["device-alloc-in-steploop"]
+
+    # Loop-dependent args (the per-batch jnp.asarray staging) are the
+    # intended use, not a hoistable constant.
+    clean = """
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(lambda s, b: s)
+
+    def train(state, batches):
+        for batch in batches:
+            staged = jnp.asarray(batch)
+            state = step(state, staged)
+    """
+    assert _lint_hot(clean, only=["device-alloc-in-steploop"]) == []
+
+
+def test_hot_python_loop_over_device_array():
+    seeded = """
+    import jax
+
+    step = jax.jit(lambda s: s)
+
+    def scan_all(state, n):
+        out = step(state)
+        for row in out:
+            use(row)
+        for i in range(n):
+            use(out[i])
+    """
+    assert _rules_of(
+        _lint_hot(seeded, only=["python-loop-over-device-array"])
+    ) == ["python-loop-over-device-array"] * 2
+
+    # One bulk materialization first is the documented escape hatch.
+    clean = """
+    import jax
+    import numpy as np
+
+    step = jax.jit(lambda s: s)
+
+    def scan_all(state):
+        out = step(state)
+        out = np.asarray(out)
+        for row in out:
+            use(row)
+    """
+    assert _lint_hot(clean, only=["python-loop-over-device-array"]) == []
+
+
+def test_hot_rules_registered_and_family_glob_selects():
+    """All six rules ride the default suite, and the `hot-*` family glob
+    selects exactly the family even though most rule names don't start
+    with "hot-" (the engine matches family-qualified names too)."""
+    from moolib_tpu.analysis.engine import all_rules, _select_rules
+
+    names = {r.name for r in all_rules()}
+    assert set(_HOT_RULES) <= names
+    selected = {r.name for r in _select_rules(None, ["hot-*"])}
+    assert selected == set(_HOT_RULES)

@@ -207,3 +207,58 @@ def test_telemetry_dump_crawls_cohort_from_one_address(tmp_path):
         lurker.close()
         a.close()
         b.close()
+
+
+def test_moolint_diff_mode_changed_untracked_and_empty():
+    """--diff REF lints only files changed vs the ref: an untracked
+    seeded file is picked up; paths with no changed lintable files exit
+    0 with a note; a bad ref exits 2."""
+    scratch = REPO_ROOT / "tests" / "_diff_scratch_tmp.py"
+    scratch.write_text(
+        "import asyncio\nimport time\n\n"
+        "async def handler():\n    time.sleep(1)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(MOOLINT), "--diff", "HEAD",
+             "--no-baseline", str(scratch)],
+            capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "async-blocking-call" in proc.stdout
+    finally:
+        scratch.unlink()
+
+    # Empty change set under the requested paths: clean exit, clear note.
+    # (An empty in-repo dir: nothing under it can ever be changed.)
+    import tempfile
+
+    empty = tempfile.mkdtemp(dir=str(REPO_ROOT / "tests"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(MOOLINT), "--diff", "HEAD", empty],
+            capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+        )
+    finally:
+        os.rmdir(empty)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no changed lintable files" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--diff", "no-such-ref-xyz"],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "no-such-ref-xyz" in proc.stderr
+
+
+def test_moolint_diff_rejects_baseline_update():
+    """A diff-scoped lint sees a slice of the tree; letting it rewrite
+    the whole baseline ledger would silently drop every other entry."""
+    proc = subprocess.run(
+        [sys.executable, str(MOOLINT), "--diff", "HEAD",
+         "--baseline-update"],
+        capture_output=True, text=True, cwd=str(REPO_ROOT), timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "conflicts" in proc.stderr

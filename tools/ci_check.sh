@@ -12,9 +12,11 @@ fmt=text
 if [ -n "${GITHUB_ACTIONS:-}" ]; then fmt=gha; fi
 
 echo "== moolint: moolib_tpu/ =="
-# --rule-times: per-rule wall-time for the 7-family suite rides the run
+# --rule-times: per-rule wall-time for the 9-family suite rides the run
 # that lints the tree anyway, so a rule that goes quadratic is caught by
-# eye here before it is caught by the test-suite budget.
+# eye here before it is caught by the test-suite budget. (The hot family
+# memoizes its cross-module jit-binding resolution on the lint context,
+# so its five data-flow rules bill the whole-tree walk once.)
 python tools/moolint.py --check --format="$fmt" --rule-times moolib_tpu/
 
 echo "== moolint: tools/ tests/ bench*.py =="
@@ -53,6 +55,19 @@ echo "== perf smoke =="
 env JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/perf.py \
   --suite cpu-proxy --smoke --trends bench/trends.jsonl
+
+echo "== hotwatch gate =="
+# hotlint's dynamic mirror (docs/analysis.md, "hotlint"): the Hotwatch
+# window contracts themselves (planted .item() caught with its site
+# stack, staged copies free, compile flatness, thread scoping) plus the
+# two e2e rows — the real donating IMPALA train step under a
+# zero-D2H/zero-H2D/zero-compile window, and the examples' actor
+# boundary with its two designed per-step syncs exactly budgeted. The
+# cpu-proxy suite above re-measures the same learner window as the
+# e2e_learner_step_s bench row, so steady-state transfer regressions are
+# caught twice: here as a named assertion, there as a trend row.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_hotwatch.py -q -p no:cacheprovider
 
 echo "== chaos + serving smoke =="
 # Bounded seeded fault-injection pass (12 scenarios, well under 60s,

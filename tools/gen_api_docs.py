@@ -75,6 +75,9 @@ MODULES = [
     ("moolib_tpu.testing.restrack", "dynamic resource-leak tracker: "
      "acquisition/release pairing for threads, shm, Rpcs, gauges "
      "(lifelint's runtime mirror)"),
+    ("moolib_tpu.testing.hotwatch", "dynamic transfer/compile gate: "
+     "counted D2H/H2D window with staged-copy accounting and compile "
+     "flatness (hotlint's runtime mirror)"),
     ("moolib_tpu.serving", "fault-tolerant serving tier: replicated "
      "inference behind a load-aware router"),
     ("moolib_tpu.serving.admission", "bounded admission queues, "
@@ -120,8 +123,9 @@ MODULES = [
     ("moolib_tpu.utils.flops", "analytic FLOPs accounting / MFU"),
     ("moolib_tpu.utils.nest", "nested-structure utilities"),
     ("moolib_tpu.analysis", "moolint: async-RPC safety, JAX trace hygiene, "
-     "sharding/collective consistency, RPC round-balance, race/lock-order "
-     "+ resource-lifecycle static analysis (tier-1 enforced)"),
+     "sharding/collective consistency, RPC round-balance, race/lock-order, "
+     "resource-lifecycle + hot-path device/host discipline static analysis "
+     "(tier-1 enforced)"),
     ("moolib_tpu.bench.harness", "perfwatch harness: timing protocol + "
      "unified result schema"),
     ("moolib_tpu.bench.suite", "CPU-proxy perf suite (runs on every PR, "

@@ -18,6 +18,7 @@ green — shrink it with --baseline-update.
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
 
@@ -61,6 +62,13 @@ def main(argv=None) -> int:
                          "reached 0 and the baseline must stay empty")
     ap.add_argument("--list-rules", action="store_true",
                     help="list registered rules and exit")
+    ap.add_argument("--diff", metavar="REF", default=None,
+                    help="lint only files changed vs the git REF "
+                         "(committed, staged, unstaged, and untracked "
+                         "changes), restricted to the requested paths — "
+                         "the fast local/pre-commit mode; composes with "
+                         "the content-hash cache. No changed lintable "
+                         "files exits 0 with a note")
     ap.add_argument("--only", action="append", default=None, metavar="RULE",
                     help="run only these rules (repeatable / comma lists; "
                          "fnmatch globs like 'race-*' select a family)")
@@ -122,6 +130,22 @@ def main(argv=None) -> int:
         return baseline_stats(args, only)
 
     paths = [Path(p) for p in (args.paths or [REPO_ROOT / "moolib_tpu"])]
+    if args.diff is not None:
+        if args.baseline_update:
+            # A diff-scoped lint sees a slice of the tree; writing that
+            # slice out as the baseline would silently drop every other
+            # file's entries.
+            print("moolint: error: --diff conflicts with "
+                  "--baseline-update (a partial lint must not rewrite "
+                  "the whole ledger)", file=sys.stderr)
+            return 2
+        paths = _changed_lint_files(args.diff, paths)
+        if paths is None:
+            return 2
+        if not paths:
+            print(f"moolint: --diff {args.diff}: no changed lintable "
+                  "files under the requested paths; nothing to lint")
+            return 0
     only = None
     if args.only:
         only = [r for chunk in args.only for r in chunk.split(",") if r]
@@ -207,6 +231,34 @@ def main(argv=None) -> int:
                       f"hit(s), {cache_stats.get('misses', 0)} miss(es) "
                       f"({DEFAULT_CACHE.name}; --no-cache disables)")
     return 1 if new else 0
+
+
+def _changed_lint_files(ref: str, requested):
+    """Lintable files changed vs git ``ref`` — committed, staged, and
+    unstaged changes (``git diff --name-only REF``) plus untracked files
+    — intersected with what linting ``requested`` would visit. Returns
+    None on a git failure (unknown ref, not a repo): the caller exits 2.
+    Files deleted since REF show in the diff but not in the lintable
+    set, so they drop out naturally."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "-z", ref, "--"],
+            cwd=REPO_ROOT, capture_output=True, text=True, check=True)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard", "-z"],
+            cwd=REPO_ROOT, capture_output=True, text=True, check=True)
+    except (subprocess.CalledProcessError, OSError) as e:
+        msg = (getattr(e, "stderr", "") or str(e)).strip()
+        print(f"moolint: error: --diff {ref}: {msg}", file=sys.stderr)
+        return None
+    changed = {p for out in (diff.stdout, untracked.stdout)
+               for p in out.split("\0") if p}
+    try:
+        scoped = list_lint_files(requested, root=REPO_ROOT)
+    except LintError as e:
+        print(f"moolint: error: {e}", file=sys.stderr)
+        return None
+    return [REPO_ROOT / rel for rel in scoped if rel in changed]
 
 
 def _print_rule_times(timings: dict):
