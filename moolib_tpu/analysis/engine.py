@@ -405,6 +405,25 @@ class Rule:
     #: family even though its rule names keep their descriptive spellings
     #: (host-transfer-in-steploop etc.). Empty = name-only matching.
     family: str = ""
+    #: Optional seeded/clean example pair for ``moolint --explain`` —
+    #: sourced here (the rule class) so the CLI and docs can never drift
+    #: from the implementation. Empty = no example published yet.
+    example_bad: str = ""
+    example_good: str = ""
+
+    def suppression_grammar(self) -> str:
+        """How to silence this rule in place. Families with a reasoned
+        marker grammar (race/hot/life/num) override the default
+        ``# moolint: disable=<rule>`` engine-level form."""
+        if self.family in ("race", "hot", "life", "num"):
+            marker = {"race": "racelint: unguarded",
+                      "hot": "hotlint: sync",
+                      "life": "lifelint: intentional",
+                      "num": f"numlint: {self.name}"}[self.family]
+            return (f"# {marker} -- <reason>   "
+                    f"(a bare marker suppresses nothing and is itself "
+                    f"flagged)")
+        return f"# moolint: disable={self.name}"
 
     def check(self, ctx: "ModuleContext") -> Iterable[Finding]:
         raise NotImplementedError
@@ -653,9 +672,10 @@ def all_rules() -> List[Rule]:
     sharding/collective consistency + RPC round/counter balance + RPC
     wire-surface consistency + benchmark timing hygiene + guarded-field
     / lock-order race analysis + resource-lifecycle / shutdown-path
-    analysis + hot-path device/host discipline)."""
+    analysis + hot-path device/host discipline + numerics/determinism
+    discipline)."""
     from . import (rules_async, rules_bench, rules_hot, rules_jax,
-                   rules_lifecycle, rules_protocol, rules_race,
+                   rules_lifecycle, rules_num, rules_protocol, rules_race,
                    rules_sharding, rules_wire)
 
     return [
@@ -664,7 +684,7 @@ def all_rules() -> List[Rule]:
                     + rules_sharding.RULES + rules_protocol.RULES
                     + rules_wire.RULES + rules_bench.RULES
                     + rules_race.RULES + rules_lifecycle.RULES
-                    + rules_hot.RULES)
+                    + rules_hot.RULES + rules_num.RULES)
     ]
 
 

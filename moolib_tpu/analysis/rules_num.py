@@ -1,0 +1,1027 @@
+"""numlint: numerics & determinism discipline (the 10th rule family).
+
+ROADMAP items 2 and 4 (quantized allreduce, comms/compute overlap) are
+gated on *seeded learning parity* — two runs from the same seed must be
+bitwise-comparable before a perf change can be judged against them.
+These rules police the four ways repos silently lose that property:
+PRNG-key discipline (a key is single-use; sampling twice from one key
+correlates the draws), unseeded randomness (np.random module state has
+no seed contract), precision discipline (fp16/bf16 accumulation and
+weak-type promotion change results across jax versions and backends),
+and reduction-order determinism (iterating a set/dict into a tensor or
+a reduce payload makes the summation order hash-seed dependent).
+
+The dynamic mirror is :mod:`moolib_tpu.testing.paritywatch`: these rules
+catch what is visible lexically; ParityWatch replays a seeded callable
+and asserts the bits actually match.
+
+Suppression grammar (mirrors racelint/hotlint/lifelint): a finding is
+silenced by a *reasoned* marker naming the rule on the flagged line::
+
+    # numlint: prng-key-reuse -- broadcast key, every peer must draw the same
+
+A bare marker (no reason) suppresses nothing and is itself flagged by
+``num-bare-suppression``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import (Finding, ModuleContext, Rule, iter_scoped,
+                     iter_scoped_body, terminal_name)
+from .rules_hot import _all_import_bindings, _jnp_aliases
+from .rules_jax import _numpy_aliases, traced_functions
+
+__all__ = ["RULES"]
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+
+#: One regex for marker + reason: rule names are hyphenated, so the
+#: reason MUST be set off by an explicit ``--`` (or em/en dash) — a
+#: looser separator class would backtrack into the rule name itself.
+_NUM_MARKER_RE = re.compile(
+    r"#\s*numlint:\s*([a-z][a-z0-9-]*[a-z0-9]|[a-z])"
+    r"\s*(?:(?:--|—|–)\s*(\S.*))?")
+
+#: jax.random consumers that burn the key they are given. ``split`` is
+#: both a consumer (of its argument) and a derivation (of its results);
+#: ``fold_in`` derives without burning — folding different data into one
+#: base key is the documented fan-out pattern.
+_SAMPLERS = {
+    "ball", "bernoulli", "beta", "bits", "categorical", "cauchy",
+    "choice", "dirichlet", "exponential", "gamma", "gumbel", "laplace",
+    "logistic", "maxwell", "multivariate_normal", "normal", "orthogonal",
+    "permutation", "poisson", "rademacher", "randint", "shuffle", "t",
+    "truncated_normal", "uniform", "weibull_min",
+}
+_KEY_SOURCES = {"PRNGKey", "key", "split", "fold_in", "clone"}
+_DERIVERS = {"split", "fold_in", "clone"}
+
+#: np.random module-level functions that draw from (or reseed) the
+#: process-global MT19937 state — the no-seed-contract surface.
+_NP_GLOBAL_RANDOM = {
+    "beta", "binomial", "bytes", "chisquare", "choice", "dirichlet",
+    "exponential", "gamma", "geometric", "gumbel", "laplace", "logistic",
+    "lognormal", "multinomial", "multivariate_normal", "normal",
+    "permutation", "poisson", "rand", "randint", "randn", "random",
+    "random_sample", "ranf", "sample", "seed", "shuffle",
+    "standard_normal", "uniform", "vonmises", "weibull",
+}
+
+_LOWPREC_DTYPES = {"float16", "bfloat16", "half"}
+_FP64_DTYPES = {"float64", "double"}
+_F32_DTYPES = {"float32", "single"}
+#: Reductions whose accumulator dtype follows the operand dtype unless
+#: explicitly widened (dtype=/preferred_element_type=).
+_ACCUM_CALLS = {"sum", "mean", "einsum", "matmul", "dot", "tensordot",
+                "vdot", "inner", "prod", "cumsum", "var", "std"}
+_UPCAST_KWARGS = ("dtype", "preferred_element_type", "precision")
+
+
+# -- suppression grammar ------------------------------------------------------
+
+
+def _num_suppressions(ctx: ModuleContext) -> Dict[int, List[Tuple[str, bool]]]:
+    """line -> [(rule, has_reason), ...] for every ``# numlint:`` marker.
+    Only real comments count (``ctx.comments`` is tokenize-derived), so
+    markers inside fixture strings neither suppress nor trip the bare
+    rule. Memoized on the context: every rule in the family consults it."""
+    cached = getattr(ctx, "_num_suppressions", None)
+    if cached is not None:
+        return cached
+    out: Dict[int, List[Tuple[str, bool]]] = {}
+    for i, text in ctx.comments:
+        if "numlint" not in text:
+            continue
+        m = _NUM_MARKER_RE.search(text)
+        if m is None:
+            continue
+        out.setdefault(i, []).append(
+            (m.group(1), bool(m.group(2) and m.group(2).strip()))
+        )
+    ctx._num_suppressions = out
+    return out
+
+
+def _suppressed(ctx: ModuleContext, node: ast.AST, rule: str) -> bool:
+    for marked, reasoned in _num_suppressions(ctx).get(
+            getattr(node, "lineno", -1), ()):
+        if reasoned and marked in (rule, "all"):
+            return True
+    return False
+
+
+# -- jax.random recognition ---------------------------------------------------
+
+
+def _random_module_names(ctx: ModuleContext) -> Set[str]:
+    """Names bound to the jax.random module (``jrandom``, ``random`` from
+    ``from jax import random``, ...)."""
+    cached = getattr(ctx, "_num_random_aliases", None)
+    if cached is not None:
+        return cached
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.random":
+                    out.add(alias.asname or "jax")
+        elif isinstance(node, ast.ImportFrom):
+            mod = ctx._absolutize_import(node)
+            if mod == "jax":
+                for alias in node.names:
+                    if alias.name == "random":
+                        out.add(alias.asname or alias.name)
+    ctx._num_random_aliases = out
+    return out
+
+
+def _bare_random_bindings(ctx: ModuleContext) -> Set[str]:
+    """Local names from-imported out of jax.random itself
+    (``from jax.random import split, PRNGKey``)."""
+    return {
+        name for name, (mod, orig) in _all_import_bindings(ctx).items()
+        if mod == "jax.random" and orig in (_SAMPLERS | _KEY_SOURCES)
+    }
+
+
+def _random_call_fn(ctx: ModuleContext, call: ast.Call) -> Optional[str]:
+    """The jax.random function name this call invokes, or None. Covers
+    ``jax.random.split``, ``<alias>.split`` for a jax.random alias, and
+    bare ``split`` from-imported out of jax.random."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        # jax.random.X
+        if isinstance(base, ast.Attribute) and base.attr == "random" \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "jax":
+            return fn.attr
+        # <random-alias>.X
+        if isinstance(base, ast.Name) \
+                and base.id in _random_module_names(ctx) \
+                and base.id != "jax":
+            return fn.attr
+        return None
+    if isinstance(fn, ast.Name) and fn.id in _bare_random_bindings(ctx):
+        bound = _all_import_bindings(ctx).get(fn.id)
+        return bound[1] if bound else None
+    return None
+
+
+def _is_key_source(ctx: ModuleContext, expr: ast.expr) -> bool:
+    """Does ``expr`` evaluate to a PRNG key (or key array)?"""
+    if isinstance(expr, ast.Call):
+        fn = _random_call_fn(ctx, expr)
+        return fn in _KEY_SOURCES
+    return False
+
+
+def _tracked_name(expr: ast.expr) -> Optional[str]:
+    """A trackable key binding: plain local name or ``self.attr``."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return f"self.{expr.attr}"
+    return None
+
+
+def _target_names(target: ast.expr) -> List[str]:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(_target_names(elt))
+        return out
+    name = _tracked_name(target)
+    return [name] if name else []
+
+
+def _callee_consumes_key(ctx: ModuleContext, call: ast.Call,
+                         key_positions: List[int]) -> bool:
+    """One call hop: does the (project-resolvable) callee burn the key it
+    receives at any of ``key_positions``? True only on positive evidence
+    — a callee that merely splits/folds its parameter derives fresh keys
+    and is treated as consuming too (the caller handed over the value; a
+    second independent use of the same key elsewhere still correlates).
+    Unresolvable callees are trusted (silence bias)."""
+    callee = terminal_name(call.func)
+    if callee is None or isinstance(call.func, ast.Attribute):
+        return False
+    resolved = ctx.project.resolve_function(ctx, callee)
+    if resolved is None:
+        return False
+    target_ctx, fn = resolved
+    params = [a.arg for a in fn.args.args]
+    for pos in key_positions:
+        if pos >= len(params):
+            continue
+        pname = params[pos]
+        for node in iter_scoped_body(fn.body):
+            if not isinstance(node, ast.Call):
+                continue
+            rfn = _random_call_fn(target_ctx, node)
+            if rfn in (_SAMPLERS | _DERIVERS) and any(
+                isinstance(a, ast.Name) and a.id == pname
+                for a in node.args
+            ):
+                return True
+    return False
+
+
+class PrngKeyReuse(Rule):
+    """The same jax.random key must never feed two consuming calls: the
+    draws are correlated (often identical), which silently breaks both
+    exploration and seeded-parity comparisons. Tracks key values through
+    plain-name/self-attr rebinds within a scope and one project-resolvable
+    call hop; a loop body that samples from a key it does not re-derive
+    (split/fold_in) inside the loop is the same bug once per iteration."""
+
+    name = "prng-key-reuse"
+    family = "num"
+    description = (
+        "a jax.random key flows into two consuming calls (or a loop "
+        "samples without split/fold_in) — split the key per use"
+    )
+    example_bad = (
+        "key = jax.random.PRNGKey(0)\n"
+        "a = jax.random.normal(key, (8,))\n"
+        "b = jax.random.uniform(key, (8,))   # same bits drive both draws"
+    )
+    example_good = (
+        "key = jax.random.PRNGKey(0)\n"
+        "ka, kb = jax.random.split(key)\n"
+        "a = jax.random.normal(ka, (8,))\n"
+        "b = jax.random.uniform(kb, (8,))"
+    )
+
+    @staticmethod
+    def _key_params(fn: ast.AST) -> Dict[str, str]:
+        """Parameters that are keys by naming convention (``key``,
+        ``rng``, ``*_key``, ``key_*``, ...) enter the scope live:
+        consumption only registers at jax.random calls (or a resolved
+        callee's key position), so a same-named non-key parameter can
+        never reach a finding."""
+        args = fn.args
+        names = [a.arg for a in
+                 args.posonlyargs + args.args + args.kwonlyargs]
+        out: Dict[str, str] = {}
+        for n in names:
+            low = n.lower()
+            if low in ("key", "rng", "subkey", "prng") \
+                    or low.endswith(("_key", "_rng")) \
+                    or low.startswith(("key_", "rng_")):
+                out[n] = "live"
+        return out
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        out.extend(self._check_scope(ctx, ctx.tree.body))
+        in_class: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            # self-attr flow: a key stored on the instance in ANY method
+            # (typically __init__) enters every other method's scope live
+            # — consuming it twice in one method, or in a loop, without a
+            # `self.X, ... = split(self.X)` rekey is the same reuse.
+            attrs: Set[str] = set()
+            methods = [m for m in node.body if isinstance(m, _FN_NODES)]
+            for m in methods:
+                for sub in iter_scoped_body(m.body):
+                    if isinstance(sub, ast.Assign) \
+                            and _is_key_source(ctx, sub.value):
+                        for t in sub.targets:
+                            for n in _target_names(t):
+                                if n.startswith("self."):
+                                    attrs.add(n)
+            for m in methods:
+                in_class.add(id(m))
+                seed = {a: "live" for a in attrs}
+                seed.update(self._key_params(m))
+                out.extend(self._check_scope(ctx, m.body, seed=seed))
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FN_NODES) and id(node) not in in_class:
+                out.extend(self._check_scope(
+                    ctx, node.body, seed=self._key_params(node)
+                ))
+        return out
+
+    # -- per-scope linear key-lifetime tracking ---------------------------
+
+    def _check_scope(self, ctx: ModuleContext, body: List[ast.stmt],
+                     seed: Optional[Dict[str, str]] = None
+                     ) -> List[Finding]:
+        findings: List[Finding] = []
+        # root name -> "live" (derived, unconsumed) | "used" (consumed
+        # once). Aliases (`k2 = k`) map into their root via ``roots`` so
+        # the whole alias group shares ONE lifetime.
+        state: Dict[str, str] = dict(seed or {})
+        self._roots: Dict[str, str] = {}
+        self._walk(ctx, body, state, findings, in_loop=False)
+        return findings
+
+    def _root(self, name: str) -> str:
+        seen = set()
+        while name in self._roots and name not in seen:
+            seen.add(name)
+            name = self._roots[name]
+        return name
+
+    def _kill(self, state: Dict[str, str], names: Iterable[str]):
+        for n in names:
+            self._roots.pop(n, None)
+            state.pop(n, None)
+
+    def _consume(self, ctx: ModuleContext, state: Dict[str, str],
+                 name: str, node: ast.AST, findings: List[Finding],
+                 in_loop: bool, loop_fresh: Set[str],
+                 rekey: bool = False):
+        name = self._root(name)
+        if state.get(name) == "used":
+            if not _suppressed(ctx, node, self.name):
+                findings.append(self.finding(
+                    ctx, node,
+                    f"PRNG key {name!r} is consumed a second time — the "
+                    f"draws are correlated; split() it per use",
+                ))
+            return
+        if state.get(name) == "live":
+            if in_loop and name not in loop_fresh and not rekey:
+                # Derived outside the loop, consumed inside: iteration 2
+                # replays iteration 1's bits.
+                if not _suppressed(ctx, node, self.name):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"PRNG key {name!r} is consumed inside a loop but "
+                        f"derived outside it — every iteration reuses the "
+                        f"same bits; split/fold_in per iteration",
+                    ))
+                state[name] = "used"
+                return
+            state[name] = "used"
+
+    def _scan_uses(self, ctx: ModuleContext, stmt: ast.stmt,
+                   state: Dict[str, str], findings: List[Finding],
+                   in_loop: bool, loop_fresh: Set[str],
+                   rekey_names: Set[str] = frozenset()):
+        """Consuming uses inside one statement. ``rekey_names`` are names
+        this statement reassigns from the consuming call itself (``key,
+        sub = split(key)``): the consumption is real (a second use of an
+        already-used key still flags) but the loop-staleness check is
+        waived — the reassignment makes the next iteration fresh."""
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _random_call_fn(ctx, node)
+            if fn in (_SAMPLERS | {"split"}):
+                for a in node.args:
+                    name = _tracked_name(a)
+                    if name is not None and self._root(name) in state:
+                        self._consume(ctx, state, name, node, findings,
+                                      in_loop, loop_fresh,
+                                      rekey=name in rekey_names)
+            elif fn is None and node.args:
+                positions = [
+                    i for i, a in enumerate(node.args)
+                    if _tracked_name(a) is not None
+                    and self._root(_tracked_name(a)) in state
+                ]
+                if positions and _callee_consumes_key(ctx, node, positions):
+                    for i in positions:
+                        name = _tracked_name(node.args[i])
+                        assert name is not None
+                        self._consume(ctx, state, name, node, findings,
+                                      in_loop, loop_fresh)
+
+    def _walk(self, ctx: ModuleContext, stmts: List[ast.stmt],
+              state: Dict[str, str], findings: List[Finding],
+              in_loop: bool, loop_fresh: Optional[Set[str]] = None):
+        loop_fresh = loop_fresh if loop_fresh is not None else set()
+        for stmt in stmts:
+            if isinstance(stmt, _FN_NODES + (ast.ClassDef, ast.Lambda)):
+                continue  # separate scope, checked on its own
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                value = stmt.value
+                names: List[str] = []
+                for t in targets:
+                    names.extend(_target_names(t))
+                rekey = (set(names)
+                         if value is not None and _is_key_source(ctx, value)
+                         else set())
+                self._scan_uses(ctx, stmt, state, findings, in_loop,
+                                loop_fresh, rekey_names=rekey)
+                if value is not None and _is_key_source(ctx, value):
+                    for n in names:
+                        self._roots.pop(n, None)
+                        state[n] = "live"
+                        loop_fresh.add(n)
+                elif value is not None and isinstance(value, ast.Name) \
+                        and self._root(value.id) in state:
+                    # Alias: both names denote the same key value — the
+                    # alias group shares ONE lifetime through its root.
+                    for n in names:
+                        if n != self._root(value.id):
+                            self._roots[n] = self._root(value.id)
+                        loop_fresh.add(n)
+                else:
+                    self._kill(state, names)
+                continue
+            if isinstance(stmt, ast.If):
+                self._scan_uses(ctx, stmt.test, state, findings, in_loop,
+                                loop_fresh)
+                before = dict(state)
+                s_body = dict(before)
+                self._walk(ctx, stmt.body, s_body, findings, in_loop,
+                           loop_fresh)
+                s_else = dict(before)
+                self._walk(ctx, stmt.orelse, s_else, findings, in_loop,
+                           loop_fresh)
+                # Merge: consumed-in-either counts once (sibling branches
+                # are exclusive — a use in each arm is NOT reuse).
+                for k in set(s_body) | set(s_else):
+                    a, b = s_body.get(k), s_else.get(k)
+                    if a is None or b is None:
+                        state.pop(k, None)
+                    else:
+                        state[k] = "used" if "used" in (a, b) else "live"
+                continue
+            if isinstance(stmt, _LOOP_NODES):
+                fresh: Set[str] = set()
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    for n in _target_names(stmt.target):
+                        state.pop(n, None)
+                        fresh.add(n)
+                else:
+                    self._scan_uses(ctx, stmt.test, state, findings,
+                                    in_loop, loop_fresh)
+                self._walk(ctx, stmt.body, state, findings, in_loop=True,
+                           loop_fresh=fresh)
+                self._walk(ctx, stmt.orelse, state, findings, in_loop,
+                           loop_fresh)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan_uses(ctx, item.context_expr, state,
+                                    findings, in_loop, loop_fresh)
+                self._walk(ctx, stmt.body, state, findings, in_loop,
+                           loop_fresh)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk(ctx, stmt.body, state, findings, in_loop,
+                           loop_fresh)
+                for h in stmt.handlers:
+                    self._walk(ctx, h.body, state, findings, in_loop,
+                               loop_fresh)
+                self._walk(ctx, stmt.orelse, state, findings, in_loop,
+                           loop_fresh)
+                self._walk(ctx, stmt.finalbody, state, findings, in_loop,
+                           loop_fresh)
+                continue
+            self._scan_uses(ctx, stmt, state, findings, in_loop, loop_fresh)
+
+
+class UnseededRandomness(Rule):
+    """Training/protocol code must not draw from the process-global
+    np.random state (no seed contract: results change run to run and
+    library imports can reseed it under you) nor derive seeds from the
+    clock. Seeded ``np.random.default_rng(seed)`` / ``Generator`` objects
+    and the ``testing/`` chaos seams (which carry their own seed-replay
+    discipline) stay clean; ``tests/``, ``tools/`` and bench scripts are
+    out of scope — the rule polices the library's training+protocol
+    paths."""
+
+    name = "unseeded-randomness"
+    family = "num"
+    description = (
+        "np.random module-state draw or time-derived seed in a library "
+        "path — use a seeded np.random.default_rng / PRNGKey"
+    )
+    example_bad = (
+        "noise = np.random.normal(size=batch.shape)  # global MT19937\n"
+        "key = jax.random.PRNGKey(int(time.time()))  # clock seed"
+    )
+    example_good = (
+        "rng = np.random.default_rng(cfg.seed)\n"
+        "noise = rng.normal(size=batch.shape)\n"
+        "key = jax.random.PRNGKey(cfg.seed)"
+    )
+
+    #: Path prefixes the rule polices; everything else (tests, tools,
+    #: bench scripts, the testing/ chaos seams) is out of scope.
+    _EXEMPT_PREFIXES = ("moolib_tpu/testing/", "tests/", "tools/", "bench")
+
+    def _in_scope(self, ctx: ModuleContext) -> bool:
+        p = ctx.relpath
+        if any(p.startswith(e) for e in self._EXEMPT_PREFIXES):
+            return False
+        return p.startswith("moolib_tpu/") or p == "<string>"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not self._in_scope(ctx):
+            return []
+        out: List[Finding] = []
+        np_names = _numpy_aliases(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self._is_global_np_draw(node, np_names):
+                if not _suppressed(ctx, node, self.name):
+                    out.append(self.finding(
+                        ctx, node,
+                        f"np.random.{node.func.attr} draws from the "
+                        f"process-global RNG state — no seed contract; "
+                        f"use a seeded np.random.default_rng(seed)",
+                    ))
+                continue
+            seed_sink = self._seed_sink(ctx, node, np_names)
+            if seed_sink and self._has_time_arg(node):
+                if not _suppressed(ctx, node, self.name):
+                    out.append(self.finding(
+                        ctx, node,
+                        f"{seed_sink} seeded from the clock — the run "
+                        f"cannot be replayed; thread a config seed through",
+                    ))
+        return out
+
+    @staticmethod
+    def _is_global_np_draw(call: ast.Call, np_names: Set[str]) -> bool:
+        fn = call.func
+        return (isinstance(fn, ast.Attribute)
+                and fn.attr in _NP_GLOBAL_RANDOM
+                and isinstance(fn.value, ast.Attribute)
+                and fn.value.attr == "random"
+                and isinstance(fn.value.value, ast.Name)
+                and fn.value.value.id in np_names)
+
+    @staticmethod
+    def _seed_sink(ctx: ModuleContext, call: ast.Call,
+                   np_names: Set[str]) -> Optional[str]:
+        """A callable whose argument is a seed: PRNGKey/jax.random.key,
+        default_rng/Generator/SeedSequence/seed, stdlib random.seed."""
+        fn = _random_call_fn(ctx, call)
+        if fn in ("PRNGKey", "key"):
+            return f"jax.random.{fn}"
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in (
+                "default_rng", "SeedSequence", "Generator", "seed"):
+            return f"np.random.{f.attr}" if terminal_name(f.value) in (
+                np_names | {"random"}) else None
+        return None
+
+    @staticmethod
+    def _has_time_arg(call: ast.Call) -> bool:
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            for node in ast.walk(a):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("time", "time_ns",
+                                               "monotonic", "monotonic_ns",
+                                               "perf_counter",
+                                               "perf_counter_ns") \
+                        and terminal_name(node.func.value) == "time":
+                    return True
+        return False
+
+
+def _lowprec_dtype_expr(expr: ast.expr) -> bool:
+    """Does ``expr`` denote an fp16/bf16 dtype (jnp.bfloat16, np.float16,
+    'float16', ...)?"""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value in _LOWPREC_DTYPES
+    return terminal_name(expr) in _LOWPREC_DTYPES
+
+
+def _f32_dtype_expr(expr: ast.expr) -> bool:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value in (_F32_DTYPES | _FP64_DTYPES)
+    return terminal_name(expr) in (_F32_DTYPES | _FP64_DTYPES)
+
+
+def _lowprec_taint(body: List[ast.stmt]) -> Set[str]:
+    """Names provably bound to fp16/bf16 arrays in this scope: explicit
+    ``dtype=<lowprec>`` constructions, ``.astype(<lowprec>)``, plus
+    propagation through plain rebinds and arithmetic on tainted names.
+    An ``.astype(float32)`` rebind clears the taint. Assignments replay
+    in source order (iter_scoped_body is unordered)."""
+    taint: Set[str] = set()
+    assigns = [n for n in iter_scoped_body(body)
+               if isinstance(n, (ast.Assign, ast.AnnAssign))]
+    for node in sorted(assigns, key=lambda n: n.lineno):
+        value = node.value
+        if value is None:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            continue
+        if _expr_lowprec(value, taint):
+            taint.update(names)
+        else:
+            taint.difference_update(names)
+    return taint
+
+
+def _expr_lowprec(expr: ast.expr, taint: Set[str]) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in taint
+    if isinstance(expr, ast.Call):
+        for kw in expr.keywords:
+            if kw.arg == "dtype":
+                return _lowprec_dtype_expr(kw.value)
+        fn = expr.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "astype":
+            if expr.args and _lowprec_dtype_expr(expr.args[0]):
+                return True
+            return False  # astype to anything else clears the taint
+        if terminal_name(fn) in _LOWPREC_DTYPES:
+            return True  # jnp.bfloat16(x)-style cast
+        return False
+    if isinstance(expr, ast.BinOp):
+        return _expr_lowprec(expr.left, taint) \
+            or _expr_lowprec(expr.right, taint)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(_expr_lowprec(e, taint) for e in expr.elts)
+    return False
+
+
+class LowprecAccumulate(Rule):
+    """fp16/bf16 storage is fine; fp16/bf16 *accumulation* is not — a
+    sum/mean/matmul that accumulates in the operand dtype loses low-order
+    bits batch-size-dependently, which both hurts training and makes
+    parity checks tolerance-dependent. Widen with ``dtype=jnp.float32``
+    (reductions) or ``preferred_element_type=jnp.float32`` (matmul/
+    einsum/dot_general) — the TPU MXU accumulates bf16 inputs in fp32 for
+    free. Fires only on positive dtype evidence in the same scope
+    (explicit dtype=/astype), resolved through the same alias layer the
+    hot family uses."""
+
+    name = "lowprec-accumulate"
+    family = "num"
+    description = (
+        "sum/mean/einsum/matmul over an fp16/bf16 array without an fp32 "
+        "accumulator (dtype=/preferred_element_type=)"
+    )
+    example_bad = (
+        "acts = h.astype(jnp.bfloat16)\n"
+        "loss = acts.mean()              # accumulates in bf16\n"
+        "y = jnp.matmul(acts, w16)       # bf16 accumulator"
+    )
+    example_good = (
+        "acts = h.astype(jnp.bfloat16)\n"
+        "loss = acts.mean(dtype=jnp.float32)\n"
+        "y = jnp.matmul(acts, w16, preferred_element_type=jnp.float32)"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        scopes: List[List[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FN_NODES):
+                scopes.append(node.body)
+        jnp_names = _jnp_aliases(ctx) | _numpy_aliases(ctx) | {"jnp"}
+        for body in scopes:
+            taint = _lowprec_taint(body)
+            if not taint:
+                continue
+            for node in iter_scoped_body(body):
+                found = self._accumulation(ctx, node, taint, jnp_names)
+                if found and not _suppressed(ctx, node, self.name):
+                    out.append(self.finding(ctx, node, found))
+        return out
+
+    def _accumulation(self, ctx: ModuleContext, node: ast.AST,
+                      taint: Set[str], jnp_names: Set[str]
+                      ) -> Optional[str]:
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+            if _expr_lowprec(node.left, taint) \
+                    or _expr_lowprec(node.right, taint):
+                return ("`@` on an fp16/bf16 operand accumulates in the "
+                        "operand dtype — use jnp.matmul(..., "
+                        "preferred_element_type=jnp.float32)")
+            return None
+        if not isinstance(node, ast.Call):
+            return None
+        fn = node.func
+        name = terminal_name(fn)
+        if name not in _ACCUM_CALLS:
+            return None
+        if any(kw.arg in _UPCAST_KWARGS for kw in node.keywords):
+            return None
+        if isinstance(fn, ast.Attribute):
+            base = terminal_name(fn.value)
+            if isinstance(fn.value, ast.Name) and base in jnp_names:
+                # jnp.sum(x) / np.einsum(eq, a, b) module form.
+                if any(_expr_lowprec(a, taint) for a in node.args):
+                    return (f"{base}.{name} accumulates in the fp16/bf16 "
+                            f"operand dtype — pass dtype=jnp.float32 / "
+                            f"preferred_element_type=jnp.float32")
+                return None
+            # method form: t.sum() / t.mean()
+            if _expr_lowprec(fn.value, taint):
+                return (f".{name}() on an fp16/bf16 array accumulates in "
+                        f"the operand dtype — pass dtype=jnp.float32 or "
+                        f"upcast first")
+        return None
+
+
+class ImplicitDtypePromotion(Rule):
+    """Inside jit-traced code, mixing Python weak-typed literals or fp64
+    values into low-precision arithmetic leans on jax's promotion lattice
+    — results silently change across jax versions/x64 mode, which is
+    exactly the drift a parity gate cannot attribute. Fires on fp64
+    dtype requests inside traced functions and on float literals mixed
+    into arithmetic with provably-fp16/bf16 operands."""
+
+    name = "implicit-dtype-promotion"
+    family = "num"
+    description = (
+        "fp64 dtype inside a traced function, or a Python float literal "
+        "mixed into fp16/bf16 arithmetic (weak-type promotion)"
+    )
+    example_bad = (
+        "@jax.jit\n"
+        "def step(x16):                    # x16: bf16\n"
+        "    scale = jnp.zeros((), jnp.float64)\n"
+        "    return x16 * 0.1 + scale      # promotion decides the dtype"
+    )
+    example_good = (
+        "@jax.jit\n"
+        "def step(x16):\n"
+        "    return x16 * jnp.bfloat16(0.1)  # explicit operand dtype"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        traced = list(traced_functions(ctx))
+        for fn in traced:
+            # dtype= kwarg values are reported by the kwarg branch; the
+            # bare-attribute branch must skip them or one site would
+            # yield two findings.
+            in_dtype_kwarg = {
+                id(kw.value)
+                for node in iter_scoped(fn) if isinstance(node, ast.Call)
+                for kw in node.keywords if kw.arg == "dtype"
+            }
+            for node in iter_scoped(fn):
+                if isinstance(node, ast.Call):
+                    for kw in node.keywords:
+                        if kw.arg == "dtype" and self._fp64(kw.value) \
+                                and not _suppressed(ctx, node, self.name):
+                            out.append(self.finding(
+                                ctx, node,
+                                "fp64 dtype inside a traced function — "
+                                "under default x64-disabled jax this "
+                                "silently becomes f32; under x64 it "
+                                "doubles the op. Pin f32 (or bf16) "
+                                "explicitly",
+                            ))
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr in _FP64_DTYPES \
+                        and isinstance(node.value, ast.Name) \
+                        and id(node) not in in_dtype_kwarg \
+                        and not _suppressed(ctx, node, self.name):
+                    out.append(self.finding(
+                        ctx, node,
+                        f"{node.value.id}.{node.attr} referenced inside a "
+                        f"traced function — x64-mode-dependent dtype",
+                    ))
+            taint = _lowprec_taint(fn.body)
+            if not taint:
+                continue
+            for node in iter_scoped_body(fn.body):
+                if isinstance(node, ast.BinOp) \
+                        and not isinstance(node.op, ast.MatMult) \
+                        and self._literal_mix(node, taint) \
+                        and not _suppressed(ctx, node, self.name):
+                    out.append(self.finding(
+                        ctx, node,
+                        "Python float literal mixed into fp16/bf16 "
+                        "arithmetic — weak-type promotion picks the "
+                        "result dtype; cast the scalar explicitly",
+                    ))
+        return out
+
+    @staticmethod
+    def _fp64(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value in _FP64_DTYPES
+        return terminal_name(expr) in _FP64_DTYPES
+
+    @staticmethod
+    def _literal_mix(node: ast.BinOp, taint: Set[str]) -> bool:
+        def is_float_lit(e: ast.expr) -> bool:
+            if isinstance(e, ast.Constant):
+                return isinstance(e.value, float)
+            if isinstance(e, ast.UnaryOp):
+                return is_float_lit(e.operand)
+            return False
+
+        return (is_float_lit(node.left) and _expr_lowprec(node.right, taint)) \
+            or (is_float_lit(node.right) and _expr_lowprec(node.left, taint))
+
+
+class NondetIterationToTensor(Rule):
+    """Iterating a set — or a dict built by iterating one — into a tensor
+    stack/concat, a reduction, or a Group reduce payload makes element
+    order hash-seed dependent, so fp summation order (and the bits of the
+    result) differs across processes. Bit-replay and cross-peer parity
+    both die here. Iterate ``sorted(...)`` instead. Plain dicts stay
+    clean: insertion order is deterministic, and the Group/nest layer
+    canonicalizes dict payloads through jax's sorted-key treedef anyway
+    (see docs/reliability.md, "Determinism & precision conventions")."""
+
+    name = "nondet-iteration-to-tensor"
+    family = "num"
+    description = (
+        "set(-seeded) iteration flows into stack/concat/reduction or an "
+        "all_reduce payload — order is hash-seed dependent; sort first"
+    )
+    example_bad = (
+        "names = {p.name for p in peers}\n"
+        "flat = jnp.stack([grads[n] for n in names])  # hash-order stack"
+    )
+    example_good = (
+        "names = {p.name for p in peers}\n"
+        "flat = jnp.stack([grads[n] for n in sorted(names)])"
+    )
+
+    _SINKS = {"stack", "concatenate", "concat", "hstack", "vstack", "sum",
+              "prod", "mean", "all_reduce"}
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        scopes: List[List[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FN_NODES):
+                scopes.append(node.body)
+        for body in scopes:
+            unordered = self._unordered_names(body)
+            for node in iter_scoped_body(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                if terminal_name(node.func) not in self._SINKS:
+                    continue
+                src = self._unordered_flow(node, unordered)
+                if src and not _suppressed(ctx, node, self.name):
+                    kind, name = src
+                    order = ("hash-seed" if kind == "set"
+                             else "set-seeded insertion")
+                    out.append(self.finding(
+                        ctx, node,
+                        f"{terminal_name(node.func)}() consumes iteration "
+                        f"over {kind} {name!r} — {order} order is "
+                        f"process-dependent, so the reduction order (and "
+                        f"its fp result) is too; iterate sorted({name})",
+                    ))
+        return out
+
+    @staticmethod
+    def _unordered_names(body: List[ast.stmt]) -> Dict[str, str]:
+        """name -> 'set' | 'dict' for provable in-scope constructions.
+        Assignments are replayed in source order (iter_scoped_body is
+        unordered) so a set-seeded dict sees its seed."""
+        out: Dict[str, str] = {}
+        assigns = [n for n in iter_scoped_body(body)
+                   if isinstance(n, (ast.Assign, ast.AnnAssign))]
+        for node in sorted(assigns, key=lambda n: n.lineno):
+            value = node.value
+            if value is None:
+                continue
+            kind = NondetIterationToTensor._unordered_kind(value, out)
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    if kind:
+                        out[t.id] = kind
+                    else:
+                        out.pop(t.id, None)
+        return out
+
+    @staticmethod
+    def _unordered_kind(expr: ast.expr,
+                        known: Dict[str, str]) -> Optional[str]:
+        """'set' for hash-ordered values; 'dict' ONLY for dicts whose
+        insertion order was seeded by iterating an unordered value (a
+        dict comprehension over a set). A plain dict literal/comp over an
+        ordered source is insertion-ordered — deterministic — and stays
+        untracked."""
+        u = NondetIterationToTensor._unordered_kind
+        if isinstance(expr, ast.Set):
+            return "set"
+        if isinstance(expr, ast.SetComp):
+            return "set"
+        if isinstance(expr, ast.DictComp):
+            if any(u(g.iter, known) for g in expr.generators):
+                return "dict"
+            return None
+        if isinstance(expr, ast.Call):
+            fn = terminal_name(expr.func)
+            if fn == "set" and isinstance(expr.func, ast.Name):
+                return "set"
+            if fn == "dict" and isinstance(expr.func, ast.Name):
+                return ("dict" if expr.args and u(expr.args[0], known)
+                        else None)
+            if fn in ("keys", "values", "items") \
+                    and isinstance(expr.func, ast.Attribute):
+                base = expr.func.value
+                if isinstance(base, ast.Name) \
+                        and known.get(base.id) == "dict":
+                    return "dict"
+        if isinstance(expr, ast.Name):
+            return known.get(expr.id)
+        return None
+
+    @staticmethod
+    def _unordered_flow(call: ast.Call, unordered: Dict[str, str]
+                        ) -> Optional[Tuple[str, str]]:
+        """(kind, source name) when an argument iterates an unordered
+        value: the value itself, a comprehension over it, or a
+        ``.keys()/.values()/.items()`` view of a known dict. ``sorted()``
+        anywhere in between launders the order and stays clean."""
+        def iter_source(e: ast.expr) -> Optional[Tuple[str, str]]:
+            if isinstance(e, ast.Name) and e.id in unordered:
+                return unordered[e.id], e.id
+            if isinstance(e, ast.Call) \
+                    and isinstance(e.func, ast.Attribute) \
+                    and e.func.attr in ("keys", "values", "items"):
+                base = e.func.value
+                if isinstance(base, ast.Name) \
+                        and unordered.get(base.id) == "dict":
+                    return "dict", base.id
+            return None
+
+        for a in call.args:
+            direct = iter_source(a)
+            if direct:
+                return direct
+            if isinstance(a, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+                for gen in a.generators:
+                    src = iter_source(gen.iter)
+                    if src:
+                        return src
+            if isinstance(a, ast.Call) \
+                    and terminal_name(a.func) in ("list", "tuple") \
+                    and a.args:
+                src = iter_source(a.args[0])
+                if src:
+                    return src
+        return None
+
+
+class NumBareSuppression(Rule):
+    """A ``# numlint: <rule>`` marker without a reason suppresses nothing
+    and is itself a finding — suppressions are one-line design docs, same
+    contract as the race/hot/lifecycle families. A marker naming a rule
+    the family does not have is a typo that would never suppress; flag it
+    too."""
+
+    name = "num-bare-suppression"
+    family = "num"
+    description = (
+        "bare or unknown-rule `# numlint:` marker — write `# numlint: "
+        "<rule> -- <reason>`"
+    )
+    example_bad = "a = jax.random.normal(key, ())  # numlint: prng-key-reuse"
+    example_good = (
+        "a = jax.random.normal(key, ())  "
+        "# numlint: prng-key-reuse -- broadcast key: peers must draw alike"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        known = {r.name for r in (RULES_INSTANCES or [])} | {"all"}
+        for lineno, marks in sorted(_num_suppressions(ctx).items()):
+            for rule, reasoned in marks:
+                node = ast.Module(body=[], type_ignores=[])
+                node.lineno, node.col_offset = lineno, 0  # type: ignore
+                if rule not in known:
+                    out.append(self.finding(
+                        ctx, node,
+                        f"`# numlint: {rule}` names no numlint rule — "
+                        f"the marker can never suppress anything",
+                    ))
+                elif not reasoned:
+                    out.append(self.finding(
+                        ctx, node,
+                        "bare `# numlint:` marker — suppressions must "
+                        "carry a reason: `# numlint: <rule> -- <why>`",
+                    ))
+        return out
+
+
+RULES = [PrngKeyReuse, UnseededRandomness, LowprecAccumulate,
+         ImplicitDtypePromotion, NondetIterationToTensor,
+         NumBareSuppression]
+
+#: Instantiated once for the bare-suppression rule's known-name check.
+RULES_INSTANCES = [cls() for cls in RULES]

@@ -113,6 +113,9 @@ TREND_TOLERANCE = {
     # XLA-compiled step on the shared CPU: compile cache is warm but the
     # matmul-heavy step competes with every neighbour for the one core.
     "e2e_learner_step_s": 0.5,
+    # Two learner steps + a full-pytree bitwise compare: same XLA noise
+    # as the e2e row, plus host-side flatten/tobytes per check.
+    "parity_check_s": 0.5,
 }
 
 
@@ -857,6 +860,68 @@ def bench_e2e_learner_step(smoke: bool) -> BenchResult:
     )
 
 
+# -- paritywatch gate cost ----------------------------------------------------
+
+
+def bench_parity_check(smoke: bool) -> BenchResult:
+    """Wall cost of one ParityWatch bitwise-replay check of the seeded
+    A2C update (docs/analysis.md, "numlint"): two donate=False step
+    executions plus the full-pytree flatten + tobytes compare. This is
+    what the CI parity gate pays per check, on the perf record so the
+    gate's budget is sized from data, not guessed — and the check
+    itself must PASS inside the timer, so the row doubles as a daily
+    bitwise-replay probe of the learner path."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..learner import (ImpalaConfig, make_impala_train_step,
+                           make_train_state)
+    from ..models import A2CNet
+    from ..testing.paritywatch import ParityWatch
+
+    t_dim, b_dim, f_dim, a_dim = (4, 4, 5, 3) if smoke else (8, 16, 5, 3)
+    net = A2CNet(num_actions=a_dim, hidden_sizes=(32,))
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, f_dim)),
+                      jnp.zeros((1, 1), bool), ())
+    state = make_train_state(params, optax.sgd(1e-3))
+    # donate=False: both replay runs must read the same input buffers.
+    step = make_impala_train_step(
+        net.apply, optax.sgd(1e-3), ImpalaConfig(), donate=False
+    )
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    batch = {
+        "obs": jax.random.normal(ks[0], (t_dim + 1, b_dim, f_dim),
+                                 jnp.float32),
+        "done": jax.random.bernoulli(ks[1], 0.1, (t_dim + 1, b_dim)),
+        "rewards": jax.random.normal(ks[2], (t_dim + 1, b_dim),
+                                     jnp.float32),
+        "actions": jax.random.randint(ks[3], (t_dim, b_dim), 0, a_dim),
+        "behavior_logits": jnp.zeros((t_dim, b_dim, a_dim), jnp.float32),
+        "core_state": (),
+    }
+    step(state, batch)  # warmup: compile outside the timed check
+    jax.block_until_ready(state)
+
+    watch = ParityWatch(label="bench_parity_check", enabled=True)
+
+    def run_check():
+        watch.check(lambda: jax.tree_util.tree_map(
+            np.asarray, step(state, batch)
+        ))
+
+    samples = measure(run_check, warmup=1, repeats=3 if smoke else 5)
+    stats = trimmed_stats(samples)
+    return _result(
+        "parity_check_s", stats["median"], "s", "lower", smoke,
+        stats=stats,
+        extra={
+            "runs_per_check": watch.runs,
+            "batch": [t_dim, b_dim, f_dim, a_dim],
+        },
+    )
+
+
 # -- registry -----------------------------------------------------------------
 
 CPU_PROXY_SUITE: Dict[str, Callable[[bool], BenchResult]] = {
@@ -873,6 +938,7 @@ CPU_PROXY_SUITE: Dict[str, Callable[[bool], BenchResult]] = {
     "serving_qps": bench_serving_qps,
     "serving_p99_latency_s": bench_serving_p99,
     "e2e_learner_step_s": bench_e2e_learner_step,
+    "parity_check_s": bench_parity_check,
 }
 
 

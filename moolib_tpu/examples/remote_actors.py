@@ -36,7 +36,50 @@ from moolib_tpu.telemetry import publish_metrics
 from moolib_tpu.examples.common import EnvBatchState
 from moolib_tpu.examples.envs import make_env_fn
 
-__all__ = ["RemoteConfig", "run_learner", "run_actor"]
+__all__ = ["RemoteConfig", "make_infer_fn", "run_learner", "run_actor"]
+
+
+def make_infer_fn(apply_fn, get_params, seed: int, lock: threading.Lock):
+    """Build the batched-inference callable ``run_learner`` serves as
+    ``infer``. Factored out so the PRNG discipline is testable on its
+    own: every call must sample with a FRESH subkey (split under
+    ``lock`` — infer runs on RPC threads, and an unguarded
+    read-modify-write of the key cell would let two concurrent calls
+    sample with the same subkey), and a given ``seed`` must replay the
+    same action sequence bit-for-bit."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def _infer(params, rng, obs, done):
+        (logits, _), _ = apply_fn(params, obs[None], done[None], ())
+        logits = logits[0]
+        a = jax.random.categorical(rng, logits, axis=-1)
+        return a, logits
+
+    infer_rng = [jax.random.PRNGKey(seed)]
+
+    def infer(obs, done):
+        # Stacked across actors by define(batch_size=): obs arrives
+        # [n_calls, B_env, ...]. Merge both batch dims into the model's B
+        # (init used [T=1, B=1, ...], so only the last obs dims are
+        # features) and unmerge the replies; pad=True keeps n_calls static
+        # so the jit compiles once.
+        obs = np.asarray(obs)
+        done = np.asarray(done)
+        n, b = done.shape
+        obs2 = obs.reshape((n * b,) + obs.shape[2:])
+        with lock:
+            params = get_params()
+            infer_rng[0], sub = jax.random.split(infer_rng[0])
+        a, logits = _infer(
+            params, sub, jnp.asarray(obs2), jnp.asarray(done.reshape(n * b))
+        )
+        a = np.asarray(a).reshape(n, b)
+        logits = np.asarray(logits).reshape(n, b, -1)
+        return a, logits
+
+    return infer
 
 
 @dataclasses.dataclass
@@ -112,34 +155,9 @@ def run_learner(cfg: RemoteConfig, listen: str = "127.0.0.1:0",
     step_fn = make_impala_train_step(net.apply, opt, ImpalaConfig(),
                                      donate=False)
 
-    @jax.jit
-    def _infer(params, rng, obs, done):
-        (logits, _), _ = net.apply(params, obs[None], done[None], ())
-        logits = logits[0]
-        a = jax.random.categorical(rng, logits, axis=-1)
-        return a, logits
-
-    infer_rng = [jax.random.PRNGKey(cfg.seed + 1)]
-
-    def infer(obs, done):
-        # Stacked across actors by define(batch_size=): obs arrives
-        # [n_calls, B_env, ...]. Merge both batch dims into the model's B
-        # (init used [T=1, B=1, ...], so only the last obs dims are
-        # features) and unmerge the replies; pad=True keeps n_calls static
-        # so the jit compiles once.
-        obs = np.asarray(obs)
-        done = np.asarray(done)
-        n, b = done.shape
-        obs2 = obs.reshape((n * b,) + obs.shape[2:])
-        with state_lock:
-            params = state.params
-        infer_rng[0], sub = jax.random.split(infer_rng[0])
-        a, logits = _infer(
-            params, sub, jnp.asarray(obs2), jnp.asarray(done.reshape(n * b))
-        )
-        a = np.asarray(a).reshape(n, b)
-        logits = np.asarray(logits).reshape(n, b, -1)
-        return a, logits
+    infer = make_infer_fn(
+        net.apply, lambda: state.params, cfg.seed + 1, state_lock
+    )
 
     rpc.define(
         "infer", infer, batch_size=cfg.infer_batch_size, pad=True,

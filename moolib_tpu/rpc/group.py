@@ -13,6 +13,23 @@ TPU context: this DCN-level collective is the *elastic, cross-cohort* path
 Dense intra-cohort gradient reduction rides XLA collectives on the ICI mesh
 instead (see moolib_tpu.parallel) — the reference has only this software
 tree (its only collective), so the TPU build strictly dominates it.
+
+REDUCTION-ORDER CONTRACT (bit-replay): for a fixed member list and fixed
+payloads, ``all_reduce`` produces *bitwise-identical* results regardless
+of peer arrival timing. Node ``i`` folds strictly in child-index order —
+``own ⊕ subtree(2i+1) ⊕ subtree(2i+2)`` — buffering any child partial
+that arrives ahead of a lower-index sibling instead of merging it on
+arrival. The full reduction order is therefore a pure function of the
+membership list and the tree shape. Floating-point reductions are NOT
+reassociated by scheduling jitter; seeded learning parity can diff
+results across runs and hosts at the bit level (see
+testing/paritywatch.py, which pins this contract in CI). A future
+hierarchical or quantized allreduce that wants a different order must
+renegotiate this contract explicitly — in its op naming/versioning —
+not drift it silently. Exception: a straggler write-off
+(``straggler_timeout``) commits a partial over the *present* subset, in
+the same fixed order over that subset; under-quorum handling is the
+caller's job (see ``all_reduce``).
 """
 
 from __future__ import annotations
@@ -98,7 +115,8 @@ class AllReduce(Future):
 class _Op:
     __slots__ = ("key", "data", "op_fn", "children", "received",
                  "future", "started", "index", "members", "forwarded",
-                 "owns", "lock", "q_deadline")
+                 "owns", "lock", "q_deadline", "pending", "next_child",
+                 "seen")
 
     def __init__(self, key, data, op_fn, index, members, future,
                  straggler_timeout: Optional[float] = None):
@@ -115,6 +133,14 @@ class _Op:
         self.future = future
         self.started = time.monotonic()
         self.forwarded = False
+        # Fixed reduction order (see module docstring): partials that
+        # arrive ahead of a lower-index sibling buffer here until the
+        # prefix fills in; next_child indexes the first child (in
+        # ascending-index order) not yet merged, and seen drops
+        # duplicate deliveries from the same child before the forward.
+        self.pending: Dict[int, Any] = {}
+        self.next_child = 0
+        self.seen: set = set()
         # data starts as the CALLER's arrays (never mutated); after the
         # first merge it is op-private and later merges may go in-place.
         self.owns = False
@@ -278,10 +304,13 @@ class Group:
                 g._apply_sync(sync_id, members)
             return True
 
-        def _on_reduce(self, op_key, payload):
+        def _on_reduce(self, op_key, payload, sender=None):
+            # sender is the child's member index — the key the fixed
+            # reduction order merges by. Peers from before the order
+            # contract omit it and fall back to arrival-order merging.
             g = self.groups.get(_group_of(op_key))
             if g is not None:
-                g._reduce_in(op_key, payload)
+                g._reduce_in(op_key, payload, sender)
             return True
 
         def _on_share(self, op_key, result):
@@ -661,8 +690,8 @@ class Group:
             self._share_in(key, parked_share[0])
             return fut
         # Drain early arrivals from children (reference: src/group.h:771-783).
-        for p_key, payload, _ts in parked:
-            self._reduce_in(p_key, payload)
+        for p_key, payload, _ts, p_sender in parked:
+            self._reduce_in(p_key, payload, p_sender)
         self._maybe_forward(op_obj)
         return fut
 
@@ -778,7 +807,7 @@ class Group:
             f.add_done_callback(make_cb(gi))
         return parent
 
-    def _reduce_in(self, op_key: str, payload):
+    def _reduce_in(self, op_key: str, payload, sender: Optional[int] = None):
         """A child's partial arrived (reference: reduce, src/group.h:570-629)."""
         with self._lock:
             op = self._active.get(op_key)
@@ -790,7 +819,7 @@ class Group:
                 # stale parks age out via _expire_ops; parks for epochs we
                 # skip entirely are pruned on resync.
                 self._parked.setdefault(op_key, []).append(
-                    (op_key, payload, time.monotonic())
+                    (op_key, payload, time.monotonic(), sender)
                 )
                 return
         if op.op_fn not in _ELEMENTWISE:
@@ -802,12 +831,13 @@ class Group:
             # NOT by pool width. Fire-and-forget by design: a failed custom
             # merge surfaces as the op's timeout, exactly like a lost hop.
             _merge_executor().submit(  # moolint: disable=dropped-future
-                self._merge_and_forward, op, payload
+                self._merge_and_forward, op, payload, sender
             )
             return
-        self._merge_and_forward(op, payload)
+        self._merge_and_forward(op, payload, sender)
 
-    def _merge_and_forward(self, op: "_Op", payload):
+    def _merge_and_forward(self, op: "_Op", payload,
+                           sender: Optional[int] = None):
         # The heavy merge runs OUTSIDE the group-wide lock (inline handlers
         # on the RPC IO thread contend on it for every message); op.lock
         # serializes merges of this op only. In-place mutation of op.data
@@ -825,16 +855,40 @@ class Group:
                     # anyway. The contribution is written off at this
                     # node; quorum callers re-contribute it next round.
                     return
+                if sender is None:
+                    # Pre-contract peer (no sender index on the wire):
+                    # arrival-order merge, the old behavior.
+                    payloads = [payload]
+                else:
+                    if sender in op.seen or sender not in op.children:
+                        # Duplicate delivery (retry/race) or not our
+                        # child: merging would double-count it.
+                        return
+                    op.seen.add(sender)
+                    op.pending[sender] = payload
+                    # Fixed reduction order: fold only the contiguous
+                    # prefix of children (ascending index) that has
+                    # arrived; anything after a gap stays buffered.
+                    payloads = []
+                    while (op.next_child < len(op.children)
+                           and op.children[op.next_child] in op.pending):
+                        payloads.append(
+                            op.pending.pop(op.children[op.next_child])
+                        )
+                        op.next_child += 1
+                    if not payloads:
+                        return  # buffered behind a lower-index sibling
                 data, owns = op.data, op.owns
-            if not (owns and _apply_inplace(op.op_fn, data, payload)):
-                data = _apply(op.op_fn, data, payload)
-                owns = op.op_fn in _ELEMENTWISE
+            for p in payloads:
+                if not (owns and _apply_inplace(op.op_fn, data, p)):
+                    data = _apply(op.op_fn, data, p)
+                    owns = op.op_fn in _ELEMENTWISE
             with self._lock:
                 if self._active.get(op.key) is not op:
                     return
                 op.data = data
                 op.owns = owns
-                op.received += 1
+                op.received += len(payloads)
         self._maybe_forward(op)
 
     def _maybe_forward(self, op: _Op):
@@ -856,7 +910,7 @@ class Group:
             parent = members[(index - 1) // 2]
             self.rpc.async_callback(
                 parent, "AllReduceService::reduce",
-                _log_err(f"reduce->{parent}"), op.key, data,
+                _log_err(f"reduce->{parent}"), op.key, data, index,
             )
 
     def _force_forward(self, op: _Op):
@@ -864,16 +918,34 @@ class Group:
         children that missed the deadline. Takes ``op.lock`` before the
         group lock — the same order as a merge — so a concurrent in-place
         merge can never be torn by the snapshot, and the ``forwarded``
-        gate it sets makes later arrivals at this node no-ops."""
+        gate it sets makes later arrivals at this node no-ops.
+
+        Partials buffered behind the straggler (arrived, but gapped off
+        from the merged prefix) are folded in first — still in ascending
+        child-index order, so the partial over the PRESENT subset keeps
+        the fixed reduction order the module docstring pins."""
         with op.lock:
             with self._lock:
                 if self._active.get(op.key) is not op or op.forwarded:
                     return
                 op.forwarded = True
-                data = op.data
+                late = [op.pending.pop(c) for c in
+                        op.children[op.next_child:] if c in op.pending]
+                data, owns = op.data, op.owns
                 index = op.index
                 members = op.members
-                missing = len(op.children) - op.received
+                missing = len(op.children) - op.received - len(late)
+            for p in late:
+                if not (owns and _apply_inplace(op.op_fn, data, p)):
+                    data = _apply(op.op_fn, data, p)
+                    owns = op.op_fn in _ELEMENTWISE
+            if late:
+                with self._lock:
+                    if self._active.get(op.key) is not op:
+                        return
+                    op.data = data
+                    op.owns = owns
+                    op.received += len(late)
         log.warning(
             "allreduce %s: straggler deadline passed — %s without %d "
             "child contribution(s)",
@@ -888,7 +960,7 @@ class Group:
             parent = members[(index - 1) // 2]
             self.rpc.async_callback(
                 parent, "AllReduceService::reduce",
-                _log_err(f"reduce->{parent}"), op.key, data,
+                _log_err(f"reduce->{parent}"), op.key, data, index,
             )
 
     def _share_in(self, op_key: str, result):

@@ -10,13 +10,15 @@ from .chaos import (ChaosNet, Event, FaultPlan, ProcChaos, ProcFaultPlan,
                     ResourceChaos, ResourceFaultPlan)
 from .hotwatch import Hotwatch, HotwatchViolation, hotwatch_enabled
 from .locktrace import LockOrderViolation, LockTrace
+from .paritywatch import ParityViolation, ParityWatch, parity_enabled
 from .restrack import ResourceLeak, ResourceTracker
 
 __all__ = ["ChaosNet", "Event", "FaultPlan", "Hotwatch",
            "HotwatchViolation", "LockOrderViolation", "LockTrace",
-           "ProcChaos", "ProcFaultPlan", "ResourceChaos",
-           "ResourceFaultPlan", "ResourceLeak", "ResourceTracker",
-           "SCENARIOS", "hotwatch_enabled"]
+           "ParityViolation", "ParityWatch", "ProcChaos",
+           "ProcFaultPlan", "ResourceChaos", "ResourceFaultPlan",
+           "ResourceLeak", "ResourceTracker", "SCENARIOS",
+           "hotwatch_enabled", "parity_enabled"]
 
 
 def __getattr__(name):

@@ -1,0 +1,445 @@
+"""paritywatch: the dynamic mirror of the numlint rule family.
+
+The static rules (analysis/rules_num.py) catch the *sources* of
+numeric drift they can see lexically — reused PRNG keys, unseeded
+draws, low-precision accumulation, weak-type promotion, unordered
+iteration into reductions. This module checks the *outcome*: a seeded
+computation must be **bitwise** reproducible, and the Group allreduce
+tree must produce bit-identical results no matter in which order the
+peers show up (the reduction-order contract pinned in
+rpc/group.py's module docstring).
+
+Two checks:
+
+- :class:`ParityWatch` runs a seeded callable ``runs`` times (default
+  twice) in one process and compares the result pytrees bit-for-bit.
+  On divergence it raises :class:`ParityViolation` naming the first
+  divergent leaf *path*, its dtype/shape, how many elements differ,
+  the first differing element pair, and the maximum ULP distance —
+  the report a numerics bisect actually needs, not a bare "arrays
+  differ". ``rtol``/``atol`` opt out of bitwise into a tolerance
+  compare for callers that knowingly reassociate (e.g. a future
+  quantized allreduce renegotiating the order contract).
+- :func:`allreduce_order_parity` stands up a real N-peer Group cohort
+  over loopback TCP (the bench suite's recipe), runs one allreduce
+  round per arrival permutation — staggering each peer's op start to
+  force different interleavings at the interior nodes — and asserts
+  every peer in every permutation got the *same bits*. Payloads mix
+  exponents so any reassociation would actually change the bits.
+
+Comparison is bitwise by design: tolerances hide exactly the class of
+bug (order-dependent summation, dtype drift) this gate exists to
+catch. ULP distance is reported, never thresholded.
+
+Off switch: ``MOOLIB_TPU_PARITYWATCH=0`` (or ``enabled=False``) turns
+:meth:`ParityWatch.check` into a single plain call — nothing is
+re-run, nothing compared.
+
+Usage (the CI gate shape)::
+
+    step = make_impala_train_step(...)
+    watch = ParityWatch(label="a2c-update")
+    state2 = watch.check(lambda: step(state0, batch))  # runs twice,
+    # raises ParityViolation on the first divergent leaf — or returns
+    # the first run's result.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ParityWatch", "ParityViolation", "parity_enabled",
+           "flatten_with_paths", "ulp_distance", "allreduce_order_parity",
+           "order_sensitive_payloads", "tree_fixed_fold"]
+
+#: numpy kind 'f' covers f2/f4/f8; extension float dtypes (ml_dtypes'
+#: bfloat16 / float8 family, registered with kind 'V') are matched by
+#: name so their ULP distance still computes through the uint view.
+_EXT_FLOAT_NAMES = ("bfloat16", "float8")
+
+
+class ParityViolation(AssertionError):
+    """Two runs (or two peers) that must agree bit-for-bit did not;
+    the message names the first divergent leaf, dtype, element count,
+    first differing pair, and max ULP distance."""
+
+
+def parity_enabled(default: bool = True) -> bool:
+    """The environment gate: ``MOOLIB_TPU_PARITYWATCH=0`` disables
+    every :class:`ParityWatch` in the process; anything else leaves
+    ``default``."""
+    v = os.environ.get("MOOLIB_TPU_PARITYWATCH", "").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return False
+    if v in ("1", "on", "true", "yes"):
+        return True
+    return default
+
+
+def _is_floatish(dtype: np.dtype) -> bool:
+    return dtype.kind == "f" or any(
+        n in dtype.name for n in _EXT_FLOAT_NAMES
+    )
+
+
+def flatten_with_paths(tree: Any, prefix: str = "") -> List[Tuple[str, Any]]:
+    """``[(path, leaf), ...]`` in jax's canonical traversal order:
+    dict keys are SORTED (what ``jax.tree_util``/``nest.flatten`` do —
+    the reason plain dict payloads are replay-deterministic), sequences
+    keep positional order, ``None`` is an empty subtree."""
+    if tree is None:
+        return []
+    if isinstance(tree, dict):
+        try:
+            keys = sorted(tree)
+        except TypeError:  # mixed/unorderable keys: sort like repr
+            keys = sorted(tree, key=repr)
+        out: List[Tuple[str, Any]] = []
+        for k in keys:
+            out.extend(flatten_with_paths(tree[k], f"{prefix}[{k!r}]"))
+        return out
+    if isinstance(tree, (list, tuple)):
+        fields = getattr(tree, "_fields", None)  # namedtuple: field order
+        out = []
+        for i, v in enumerate(tree):
+            part = f".{fields[i]}" if fields else f"[{i}]"
+            out.extend(flatten_with_paths(v, prefix + part))
+        return out
+    return [(prefix or "<root>", tree)]
+
+
+def _float_rank(a: np.ndarray) -> np.ndarray:
+    """Map float bit patterns to uint64 ranks monotonic in the float
+    ordering, so ``|rank(a) - rank(b)|`` is the ULP distance (adjacent
+    representable values differ by 1; -0.0 and +0.0 are adjacent)."""
+    bits = 8 * a.dtype.itemsize
+    u = np.ascontiguousarray(a).view(f"u{a.dtype.itemsize}")
+    u = u.astype(np.uint64)
+    sign = np.uint64(1) << np.uint64(bits - 1)
+    full = (np.uint64(0xFFFFFFFFFFFFFFFF) >> np.uint64(64 - bits))
+    return np.where(u & sign, full - u, u + sign)
+
+
+def ulp_distance(a: np.ndarray, b: np.ndarray) -> int:
+    """Max ULP distance between two same-dtype float arrays (units in
+    the last place: the number of representable values between the
+    most-divergent element pair). NaN bit patterns compare by their
+    raw rank — two different NaNs have a nonzero distance, which is
+    exactly what a bitwise gate wants to surface."""
+    a, b = np.asarray(a), np.asarray(b)
+    if a.dtype != b.dtype or not _is_floatish(a.dtype):
+        raise ValueError(
+            f"ulp_distance wants same-dtype float arrays, got "
+            f"{a.dtype}/{b.dtype}"
+        )
+    ra, rb = _float_rank(a), _float_rank(b)
+    diff = np.where(ra > rb, ra - rb, rb - ra)  # exact in uint64
+    return int(diff.max()) if diff.size else 0
+
+
+def _first_divergence(a: np.ndarray, b: np.ndarray) -> Tuple[int, tuple, int]:
+    """(differing element count, first differing index, max ULP or -1)
+    for two same-dtype same-shape arrays that are not byte-identical."""
+    if a.dtype.kind == "V" and not _is_floatish(a.dtype):
+        return 1, (), -1  # opaque records: no elementwise view
+    av = np.ascontiguousarray(a)
+    bv = np.ascontiguousarray(b)
+    if _is_floatish(a.dtype):
+        ar = _float_rank(av).reshape(-1)
+        br = _float_rank(bv).reshape(-1)
+        mask = ar != br
+        ulp = int(np.where(ar > br, ar - br, br - ar).max())
+    else:
+        mask = av.reshape(-1) != bv.reshape(-1)
+        ulp = -1
+    n = int(mask.sum())
+    if n == 0:  # bytes differed but values did not (e.g. padding)
+        return 0, (), ulp
+    flat_idx = int(np.argmax(mask))
+    idx = tuple(
+        int(i) for i in np.unravel_index(flat_idx, a.shape)
+    ) if a.shape else ()
+    return n, idx, ulp
+
+
+class ParityWatch:
+    """Bitwise replay gate for seeded computations.
+
+    Parameters
+    ----------
+    runs:
+        How many times :meth:`check` invokes the callable (default 2);
+        every run is compared against the first.
+    rtol, atol:
+        ``None``/``None`` (default) is the bitwise contract. Setting
+        either switches :meth:`compare` to ``np.allclose`` — the
+        explicit opt-out for callers that knowingly reassociate; the
+        divergence report still includes the ULP distance so the
+        opt-out's cost stays visible.
+    enabled:
+        ``None`` consults :func:`parity_enabled`; ``False`` makes
+        :meth:`check` a single plain call.
+    label:
+        Names the gate in violation messages.
+    """
+
+    def __init__(self, *, runs: int = 2, rtol: Optional[float] = None,
+                 atol: Optional[float] = None,
+                 enabled: Optional[bool] = None,
+                 label: str = "paritywatch"):
+        if runs < 2:
+            raise ValueError("runs must be >= 2 (nothing to compare)")
+        self.runs = int(runs)
+        self.rtol = rtol
+        self.atol = atol
+        self.label = label
+        self.enabled = parity_enabled() if enabled is None else bool(enabled)
+
+    @property
+    def bitwise(self) -> bool:
+        return self.rtol is None and self.atol is None
+
+    # -- comparison core ------------------------------------------------------
+
+    def compare(self, ref: Any, other: Any,
+                context: str = "run 2 vs run 1") -> None:
+        """Assert ``other`` equals ``ref`` (bitwise, or within
+        rtol/atol when opted out); raise :class:`ParityViolation` at
+        the first divergent leaf otherwise. Device arrays are
+        materialized to host — this is a test harness, not a hot
+        path."""
+        ref_leaves = flatten_with_paths(ref)
+        other_leaves = flatten_with_paths(other)
+        if [p for p, _ in ref_leaves] != [p for p, _ in other_leaves]:
+            rp = [p for p, _ in ref_leaves]
+            op = [p for p, _ in other_leaves]
+            extra = [p for p in op if p not in rp][:3]
+            gone = [p for p in rp if p not in op][:3]
+            raise ParityViolation(
+                f"{self.label}: pytree STRUCTURE diverged ({context}): "
+                f"{len(rp)} vs {len(op)} leaves"
+                + (f"; new paths {extra}" if extra else "")
+                + (f"; missing paths {gone}" if gone else "")
+            )
+        for (path, a_raw), (_p, b_raw) in zip(ref_leaves, other_leaves):
+            a, b = np.asarray(a_raw), np.asarray(b_raw)
+            if a.dtype != b.dtype:
+                raise ParityViolation(
+                    f"{self.label}: leaf {path} changed dtype "
+                    f"({context}): {a.dtype} vs {b.dtype} — promotion "
+                    f"or precision drift between runs"
+                )
+            if a.shape != b.shape:
+                raise ParityViolation(
+                    f"{self.label}: leaf {path} changed shape "
+                    f"({context}): {a.shape} vs {b.shape}"
+                )
+            if np.ascontiguousarray(a).tobytes() == \
+                    np.ascontiguousarray(b).tobytes():
+                continue
+            if not self.bitwise and _is_floatish(a.dtype):
+                af = np.asarray(a, np.float64) if a.dtype.kind != "f" \
+                    else a
+                bf = np.asarray(b, np.float64) if b.dtype.kind != "f" \
+                    else b
+                if np.allclose(af, bf, rtol=self.rtol or 0.0,
+                               atol=self.atol or 0.0, equal_nan=True):
+                    continue
+            n, idx, ulp = _first_divergence(a, b)
+            if n == 0 and self.bitwise:
+                continue  # byte padding noise, values identical
+            first = ""
+            if idx is not None and a.size:
+                av0 = a[idx] if a.shape else a[()]
+                bv0 = b[idx] if b.shape else b[()]
+                first = (f"; first at index {idx}: "
+                         f"{av0.item()!r} vs {bv0.item()!r}")
+            ulp_s = f"; max ULP distance {ulp}" if ulp >= 0 else ""
+            mode = "bitwise" if self.bitwise else (
+                f"rtol={self.rtol} atol={self.atol}")
+            raise ParityViolation(
+                f"{self.label}: first divergent leaf at {path} "
+                f"({context}, {mode}): dtype={a.dtype} shape={a.shape} "
+                f"{n}/{a.size} element(s) differ{first}{ulp_s}"
+            )
+
+    # -- the replay gate ------------------------------------------------------
+
+    def check(self, fn: Callable[..., Any], *args: Any,
+              **kwargs: Any) -> Any:
+        """Call ``fn(*args, **kwargs)`` ``runs`` times and compare
+        every result pytree against the first, bit-for-bit. Returns
+        the first run's result. The callable owns its own seeding —
+        the gate proves the *computation* is replay-deterministic, so
+        ``fn`` must thread identical keys/state into every run (the
+        numlint rules police exactly that)."""
+        ref = fn(*args, **kwargs)
+        if not self.enabled:
+            return ref
+        for k in range(1, self.runs):
+            out = fn(*args, **kwargs)
+            self.compare(ref, out, context=f"run {k + 1} vs run 1")
+        return ref
+
+
+# -- allreduce arrival-order invariance ---------------------------------------
+
+#: Default arrival permutations for a 4-peer cohort: identity, full
+#: reversal, and an interleave that swaps sibling subtrees at the root.
+_DEFAULT_PERMS: Tuple[Tuple[int, ...], ...] = (
+    (0, 1, 2, 3), (3, 2, 1, 0), (2, 0, 3, 1),
+)
+
+
+def order_sensitive_payloads(n_peers: int, size: int = 1024,
+                             seed: int = 0) -> List[np.ndarray]:
+    """Per-peer fp32 payloads with mixed exponents, so any
+    reassociation of the sum actually changes the result bits (a
+    uniform payload would hide an order bug behind symmetric values)."""
+    rng = np.random.default_rng(seed)
+    scales = [1e6, 1.0, 1e-3, 3e2, 1e-6, 7.0]
+    return [
+        (rng.standard_normal(size) * scales[i % len(scales)]).astype(
+            np.float32
+        )
+        for i in range(n_peers)
+    ]
+
+
+def tree_fixed_fold(payloads_in_member_order: List[np.ndarray],
+                    op: Callable = np.add) -> np.ndarray:
+    """The host-side reference for rpc/group.py's reduction-order
+    contract: node ``i`` folds ``own ⊕ subtree(2i+1) ⊕ subtree(2i+2)``
+    in child-index order. ``payloads_in_member_order`` indexes by TREE
+    position (the group's member-list order, which the broker's join
+    order decides — not necessarily construction order)."""
+    n = len(payloads_in_member_order)
+
+    def fold(i: int) -> np.ndarray:
+        acc = payloads_in_member_order[i]
+        for c in (2 * i + 1, 2 * i + 2):
+            if c < n:
+                acc = op(acc, fold(c))
+        return acc
+
+    return fold(0)
+
+
+def allreduce_order_parity(
+    n_peers: int = 4,
+    perms: Sequence[Sequence[int]] = _DEFAULT_PERMS,
+    payloads: Optional[List[np.ndarray]] = None,
+    stagger_s: float = 0.05,
+    timeout: float = 120.0,
+) -> np.ndarray:
+    """Stand up a real ``n_peers`` Group cohort over loopback TCP and
+    prove the allreduce is participant-arrival-order invariant: one
+    reduce round per permutation in ``perms``, with each peer's op
+    started ``stagger_s`` apart in the permuted order (so partials hit
+    the interior nodes in different interleavings), asserting every
+    peer in every round returned the SAME BITS — and that those bits
+    equal :func:`tree_fixed_fold` over the actual membership order,
+    i.e. the documented contract, not merely *some* stable order.
+    Returns the reference result array.
+
+    This is the runtime pin for the reduction-order contract in
+    rpc/group.py: before the fixed child-index merge, the root's fold
+    of its two subtrees followed arrival timing and this check flakes;
+    with the contract it must never."""
+    from ..rpc import Rpc
+    from ..rpc.broker import Broker
+    from ..rpc.group import Group
+    from ..utils import set_log_level
+
+    for perm in perms:
+        if sorted(perm) != list(range(n_peers)):
+            raise ValueError(f"{perm} is not a permutation of "
+                             f"range({n_peers})")
+    if payloads is None:
+        payloads = order_sensitive_payloads(n_peers)
+    if len(payloads) != n_peers:
+        raise ValueError("need one payload per peer")
+
+    set_log_level("error")
+    broker_rpc = Rpc("parity-broker")
+    broker_rpc.listen("127.0.0.1:0")
+    addr = broker_rpc.debug_info()["listen"][0]
+    broker = Broker(broker_rpc)
+    stop = threading.Event()
+
+    def pump_broker():
+        while not stop.is_set():
+            broker.update()
+            time.sleep(0.02)
+
+    threading.Thread(target=pump_broker, daemon=True).start()
+
+    rpcs: List[Any] = []
+    groups: List[Any] = []
+    watch = ParityWatch(label="allreduce-order", enabled=True)
+    try:
+        for i in range(n_peers):
+            r = Rpc(f"parity-ar-{i}")
+            r.listen("127.0.0.1:0")
+            r.connect(addr)
+            g = Group(r, group_name="parity",
+                      broker_name="parity-broker", timeout=timeout)
+            rpcs.append(r)
+            groups.append(g)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            for g in groups:
+                g.update()
+            if all(len(g.members) == n_peers and g.active()
+                   for g in groups):
+                break
+            time.sleep(0.02)
+        else:
+            raise RuntimeError("parity cohort never stabilized")
+        # Tree position = member-list order (broker join order), so the
+        # host-side contract fold must be computed from it, not from
+        # construction order.
+        by_name = {r.get_name(): payloads[i] for i, r in enumerate(rpcs)}
+        expected = tree_fixed_fold(
+            [by_name[m] for m in groups[0].members]
+        )
+
+        def pump():
+            while not stop.is_set():
+                for g in groups:
+                    g.update()
+                time.sleep(0.05)
+
+        threading.Thread(target=pump, daemon=True).start()
+
+        reference = expected  # every peer/round must match the contract
+        for ri, perm in enumerate(perms):
+            tag = f"order-{ri}"
+            futs: Dict[int, Any] = {}
+            for pos, peer in enumerate(perm):
+                if pos and stagger_s:
+                    time.sleep(stagger_s)
+                futs[peer] = groups[peer].all_reduce(
+                    tag, payloads[peer].copy()
+                )
+            results = {p: np.asarray(f.result(timeout=timeout))
+                       for p, f in futs.items()}
+            for peer in range(n_peers):
+                watch.compare(
+                    reference, results[peer],
+                    context=f"arrival order {tuple(perm)}, peer {peer} "
+                            f"vs the host-side fixed fold",
+                )
+        return reference
+    finally:
+        stop.set()
+        for g in groups:
+            g.close()
+        for r in rpcs:
+            r.close()
+        broker_rpc.close()

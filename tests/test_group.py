@@ -756,3 +756,81 @@ def test_expired_key_share_not_parked_for_retry(cluster):
     fut2 = g.all_reduce("stranded", np.ones(2))
     time.sleep(0.05)
     assert not fut2.done(), "retry must not complete from a stale share"
+
+
+def _root_group(cluster, group="g"):
+    """The (rpc, g) pair whose member sits at tree index 0."""
+    for rpc, g in cluster.clients:
+        if g.group_name == group and rpc.get_name() == g.members[0]:
+            return rpc, g
+    raise AssertionError("no root member found")
+
+
+def _order_payloads():
+    """Mixed-exponent fp32 payloads: fp32 summation order changes bits."""
+    rng = np.random.default_rng(3)
+    return [
+        (rng.standard_normal(256) * s).astype(np.float32)
+        for s in (1e4, 3e2, 1.0)
+    ]
+
+
+def test_allreduce_merges_in_child_index_order(cluster):
+    """The reduction-order contract, deterministically: inject child
+    partials at the root OUT of child-index order and assert the
+    result is still the fixed fold (own + child1) + child2 — the
+    higher-index partial buffers until the gap fills."""
+    for i in range(3):
+        cluster.spawn(f"peer-{i}")
+    cluster.wait_members("g", 3)
+    _, g0 = _root_group(cluster)
+    d0, p1, p2 = _order_payloads()
+    fixed = (d0 + p1) + p2
+    arrival = (d0 + p2) + p1
+    assert fixed.tobytes() != arrival.tobytes()  # order must matter
+
+    fut = g0.all_reduce("ordered", d0.copy())
+    key = fut.op_key
+    g0._reduce_in(key, p2.copy(), 2)  # child 2 first: must buffer
+    op = g0._active.get(key)
+    assert op is not None and op.received == 0 and op.pending
+    g0._reduce_in(key, p1.copy(), 1)  # gap fills: both merge, in order
+    out = fut.result(timeout=10)
+    assert np.asarray(out).tobytes() == fixed.tobytes()
+
+
+def test_allreduce_drops_duplicate_child_delivery(cluster):
+    """A duplicate partial from the same child (retry/race) must not
+    double-count now that the wire names the sender."""
+    for i in range(3):
+        cluster.spawn(f"peer-{i}")
+    cluster.wait_members("g", 3)
+    _, g0 = _root_group(cluster)
+    d0, p1, p2 = _order_payloads()
+
+    fut = g0.all_reduce("dup", d0.copy())
+    key = fut.op_key
+    g0._reduce_in(key, p2.copy(), 2)
+    g0._reduce_in(key, p2.copy(), 2)  # duplicate while buffered: dropped
+    g0._reduce_in(key, p1.copy(), 1)
+    out = fut.result(timeout=10)
+    expect = (d0 + p1) + p2
+    assert np.asarray(out).tobytes() == expect.tobytes()
+
+
+def test_allreduce_legacy_sender_merges_on_arrival(cluster):
+    """Partials without a sender index (pre-contract peers) keep the
+    old arrival-order behavior instead of stalling the round."""
+    for i in range(3):
+        cluster.spawn(f"peer-{i}")
+    cluster.wait_members("g", 3)
+    _, g0 = _root_group(cluster)
+    d0, p1, p2 = _order_payloads()
+
+    fut = g0.all_reduce("legacy", d0.copy())
+    key = fut.op_key
+    g0._reduce_in(key, p2.copy(), None)
+    g0._reduce_in(key, p1.copy(), None)
+    out = fut.result(timeout=10)
+    arrival = (d0 + p2) + p1
+    assert np.asarray(out).tobytes() == arrival.tobytes()

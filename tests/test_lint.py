@@ -3168,3 +3168,378 @@ def test_hot_rules_registered_and_family_glob_selects():
     assert set(_HOT_RULES) <= names
     selected = {r.name for r in _select_rules(None, ["hot-*"])}
     assert selected == set(_HOT_RULES)
+
+
+# -- rules: numlint (numerics & determinism discipline) -----------------------
+
+_NUM_RULES = [
+    "prng-key-reuse", "unseeded-randomness", "lowprec-accumulate",
+    "implicit-dtype-promotion", "nondet-iteration-to-tensor",
+    "num-bare-suppression",
+]
+
+
+def _lint_num(src, relpath="moolib_tpu/scratch.py", only=("num-*",)):
+    return lint_source(textwrap.dedent(src), relpath, only=list(only))
+
+
+def test_num_key_reuse_flagged_and_split_clean():
+    """The headline rule: the same key into two consuming calls is a
+    correlated-sample bug; a split fanout is the clean twin."""
+    seeded = """
+    import jax
+
+    def rollout(key):
+        a = jax.random.normal(key, (4,))
+        b = jax.random.uniform(key, (4,))
+        return a, b
+    """
+    found = _lint_num(seeded)
+    assert _rules_of(found) == ["prng-key-reuse"]
+
+    clean = """
+    import jax
+
+    def rollout(key):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, (4,))
+        b = jax.random.uniform(k2, (4,))
+        return a, b
+    """
+    assert _lint_num(clean) == []
+
+
+def test_num_key_reuse_in_loop_and_rekey_clean():
+    """Sampling the SAME key every iteration freezes the draws; the
+    `key, sub = split(key)` rekey idiom is the clean twin, and
+    fold_in(i) is equally clean."""
+    seeded = """
+    import jax
+
+    def steps(key, n):
+        out = []
+        for _ in range(n):
+            out.append(jax.random.normal(key, (2,)))
+        return out
+    """
+    assert _rules_of(_lint_num(seeded)) == ["prng-key-reuse"]
+
+    rekey = """
+    import jax
+
+    def steps(key, n):
+        out = []
+        for _ in range(n):
+            key, sub = jax.random.split(key)
+            out.append(jax.random.normal(sub, (2,)))
+        return out
+    """
+    assert _lint_num(rekey) == []
+
+    folded = """
+    import jax
+
+    def steps(key, n):
+        out = []
+        for i in range(n):
+            out.append(jax.random.normal(jax.random.fold_in(key, i), (2,)))
+        return out
+    """
+    assert _lint_num(folded) == []
+
+
+def test_num_key_reuse_through_alias_and_self_attr():
+    """Value flow the engine's other families already model: a local
+    alias shares the key's lifetime, and a self-attribute key assigned
+    in __init__ is tracked across the class's methods."""
+    alias = """
+    import jax
+
+    def f(key):
+        k2 = key
+        a = jax.random.normal(k2, (2,))
+        b = jax.random.normal(key, (2,))
+        return a, b
+    """
+    assert _rules_of(_lint_num(alias)) == ["prng-key-reuse"]
+
+    attr = """
+    import jax
+
+    class Sampler:
+        def __init__(self, seed):
+            self._key = jax.random.PRNGKey(seed)
+
+        def draw(self):
+            a = jax.random.normal(self._key, (2,))
+            b = jax.random.uniform(self._key, (2,))
+            return a, b
+    """
+    assert _rules_of(_lint_num(attr)) == ["prng-key-reuse"]
+
+    attr_rekey = """
+    import jax
+
+    class Sampler:
+        def __init__(self, seed):
+            self._key = jax.random.PRNGKey(seed)
+
+        def draw(self):
+            self._key, sub = jax.random.split(self._key)
+            return jax.random.normal(sub, (2,))
+    """
+    assert _lint_num(attr_rekey) == []
+
+
+def test_num_key_reuse_one_call_hop():
+    """A helper that consumes its key parameter counts as a use at the
+    call site (one hop, positive evidence only): passing the key to it
+    and then sampling with the same key is reuse."""
+    seeded = """
+    import jax
+
+    def helper(key):
+        return jax.random.normal(key, (2,))
+
+    def f(key):
+        a = helper(key)
+        b = jax.random.normal(key, (2,))
+        return a, b
+    """
+    assert _rules_of(_lint_num(seeded)) == ["prng-key-reuse"]
+
+    splitter = """
+    import jax
+
+    def helper(key):
+        k1, k2 = jax.random.split(key)
+        return jax.random.normal(k1, (2,)), k2
+
+    def f(key):
+        a, k2 = helper(key)
+        return a
+    """
+    assert _lint_num(splitter) == []
+
+
+def test_num_key_reuse_cross_module(tmp_path):
+    """The call-hop resolution rides the ProjectIndex: a helper imported
+    from a sibling module consumes the key at the call site too."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "sampling.py").write_text(textwrap.dedent(
+        """
+        import jax
+
+        def draw_actions(key, logits):
+            return jax.random.categorical(key, logits)
+        """
+    ))
+    (pkg / "actor.py").write_text(textwrap.dedent(
+        """
+        import jax
+        from pkg.sampling import draw_actions
+
+        def act(key, logits):
+            a = draw_actions(key, logits)
+            b = jax.random.normal(key, (2,))
+            return a, b
+        """
+    ))
+    findings = [f for f in lint_paths([pkg], root=tmp_path)
+                if f.rule == "prng-key-reuse"]
+    assert len(findings) == 1
+    assert findings[0].path.endswith("actor.py")
+
+
+def test_num_unseeded_randomness_and_seeded_generator_clean():
+    """Module-level np.random draws in training/protocol paths are
+    invisible global state; a seeded Generator is the sanctioned form,
+    and testing/ chaos seams are exempt by path."""
+    seeded = """
+    import numpy as np
+
+    def jitter(shape):
+        return np.random.uniform(size=shape)
+    """
+    found = _lint_num(seeded, relpath="moolib_tpu/parallel/x.py")
+    assert _rules_of(found) == ["unseeded-randomness"]
+
+    clean = """
+    import numpy as np
+
+    def jitter(shape, seed):
+        rng = np.random.default_rng(seed)
+        return rng.uniform(size=shape)
+    """
+    assert _lint_num(clean, relpath="moolib_tpu/parallel/x.py") == []
+
+    # Same seeded source under testing/ (chaos seams): exempt by path.
+    assert _lint_num(seeded, relpath="moolib_tpu/testing/chaos_x.py") == []
+
+
+def test_num_time_derived_seed_flagged():
+    """PRNGKey(time.time()) is unseeded randomness wearing a seed's
+    clothes — unreplayable by construction."""
+    seeded = """
+    import time
+    import jax
+
+    def make_key():
+        return jax.random.PRNGKey(int(time.time()))
+    """
+    found = _lint_num(seeded, relpath="moolib_tpu/learner/x.py")
+    assert _rules_of(found) == ["unseeded-randomness"]
+
+    clean = """
+    import jax
+
+    def make_key(seed):
+        return jax.random.PRNGKey(seed)
+    """
+    assert _lint_num(clean, relpath="moolib_tpu/learner/x.py") == []
+
+
+def test_num_lowprec_accumulate_forms_and_upcast_clean():
+    """sum/mean/matmul accumulating in bf16/fp16 loses low-order bits;
+    dtype=/preferred_element_type= upcasts are the clean twins."""
+    seeded = """
+    import jax.numpy as jnp
+
+    def loss(x16):
+        h = x16.astype(jnp.bfloat16)
+        total = h.sum()
+        avg = jnp.mean(h)
+        prod = h @ h.T
+        return total, avg, prod
+    """
+    found = _lint_num(seeded)
+    assert _rules_of(found) == ["lowprec-accumulate"] * 3
+
+    clean = """
+    import jax.numpy as jnp
+    import jax
+
+    def loss(x16):
+        h = x16.astype(jnp.bfloat16)
+        total = h.sum(dtype=jnp.float32)
+        avg = jnp.mean(h, dtype=jnp.float32)
+        prod = jax.numpy.matmul(h, h.T, preferred_element_type=jnp.float32)
+        return total, avg, prod
+    """
+    assert _lint_num(clean) == []
+
+
+def test_num_implicit_promotion_in_jit_and_clean():
+    """fp64 dtypes and float-literal mixing inside jit'd arithmetic are
+    the weak-type surprises; explicit fp32 is the clean twin."""
+    seeded = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        h = x.astype(jnp.bfloat16)
+        scaled = h * 0.5
+        big = jnp.zeros((4,), dtype=jnp.float64)
+        return scaled, big
+    """
+    found = _lint_num(seeded)
+    assert _rules_of(found) == ["implicit-dtype-promotion"] * 2
+
+    clean = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        h = x.astype(jnp.bfloat16)
+        scaled = h * jnp.bfloat16(0.5)
+        big = jnp.zeros((4,), dtype=jnp.float32)
+        return scaled, big
+    """
+    assert _lint_num(clean) == []
+
+
+def test_num_nondet_iteration_and_sorted_clean():
+    """set iteration into stack/concat changes reduction order run to
+    run; sorted() restores a deterministic order. Plain dicts are NOT
+    flagged (insertion-ordered, and pytree flattening sorts keys)."""
+    seeded = """
+    import numpy as np
+
+    def gather(parts):
+        uniq = set(parts)
+        return np.stack([p for p in uniq])
+    """
+    assert _rules_of(_lint_num(seeded)) == ["nondet-iteration-to-tensor"]
+
+    clean = """
+    import numpy as np
+
+    def gather(parts):
+        uniq = set(parts)
+        return np.stack([p for p in sorted(uniq)])
+    """
+    assert _lint_num(clean) == []
+
+    plain_dict = """
+    import numpy as np
+
+    def gather(named):
+        return np.stack([v for v in named.values()])
+    """
+    assert _lint_num(plain_dict) == []
+
+
+def test_num_set_seeded_dict_flagged():
+    """A dict BUILT from an unordered source inherits its ordering;
+    iterating it into a reduction is the same bug one hop later."""
+    seeded = """
+    import numpy as np
+
+    def gather(parts):
+        uniq = set(parts)
+        named = {p: load(p) for p in uniq}
+        return np.concatenate([v for v in named.values()])
+    """
+    assert _rules_of(_lint_num(seeded)) == ["nondet-iteration-to-tensor"]
+
+
+def test_num_suppression_grammar_round_trip():
+    """`# numlint: <rule> -- <reason>` silences the line; a bare or
+    unknown-rule marker suppresses nothing and is itself flagged."""
+    bare = """
+    import jax
+
+    def f(key):
+        a = jax.random.normal(key, (2,))
+        b = jax.random.normal(key, (2,))  # numlint: prng-key-reuse
+        return a, b
+    """
+    rules = sorted(_rules_of(_lint_num(bare)))
+    assert rules == ["num-bare-suppression", "prng-key-reuse"]
+
+    reasoned = bare.replace(
+        "# numlint: prng-key-reuse",
+        "# numlint: prng-key-reuse -- correlated draws are the point here",
+    )
+    assert _lint_num(reasoned) == []
+
+    unknown = bare.replace(
+        "# numlint: prng-key-reuse",
+        "# numlint: no-such-rule -- reason",
+    )
+    assert "num-bare-suppression" in _rules_of(_lint_num(unknown))
+
+
+def test_num_rules_registered_and_family_glob_selects():
+    """All six rules ride the default suite and `num-*` selects exactly
+    the family (family-qualified matching, like hot-*)."""
+    from moolib_tpu.analysis.engine import all_rules, _select_rules
+
+    names = {r.name for r in all_rules()}
+    assert set(_NUM_RULES) <= names
+    selected = {r.name for r in _select_rules(None, ["num-*"])}
+    assert selected == set(_NUM_RULES)

@@ -1,0 +1,262 @@
+"""ParityWatch: bitwise replay + allreduce arrival-order invariance.
+
+The dynamic half of the numlint acceptance criteria: a seeded A2C
+update must be bitwise-reproducible twice in one process, and a 4-peer
+Group allreduce must return the same bits no matter the order peers
+show up in (the reduction-order contract in rpc/group.py). The unit
+tests pin the divergence *report* — first leaf path, dtype, ULP
+distance — because that report is what a numerics bisect runs on.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from moolib_tpu.testing.paritywatch import (
+    ParityViolation,
+    ParityWatch,
+    allreduce_order_parity,
+    flatten_with_paths,
+    order_sensitive_payloads,
+    parity_enabled,
+    tree_fixed_fold,
+    ulp_distance,
+)
+
+
+# -- flatten / ulp primitives -------------------------------------------------
+
+
+def test_flatten_paths_canonical_dict_order():
+    tree = {"b": np.ones(2), "a": [np.zeros(1), {"z": np.ones(1)}]}
+    paths = [p for p, _ in flatten_with_paths(tree)]
+    # dict keys sorted (jax canonical order), sequences positional.
+    assert paths == ["['a'][0]", "['a'][1]['z']", "['b']"]
+
+
+def test_flatten_none_is_empty_subtree():
+    assert flatten_with_paths({"a": None, "b": np.ones(1)}) \
+        == flatten_with_paths({"b": np.ones(1), "a": None})
+    assert len(flatten_with_paths({"a": None})) == 0
+
+
+def test_ulp_distance_adjacent_and_zero():
+    one = np.array([1.0], np.float32)
+    nxt = np.nextafter(one, np.float32(2.0))
+    assert ulp_distance(one, one) == 0
+    assert ulp_distance(one, nxt) == 1
+    # -0.0 and +0.0 are adjacent ranks, not equal bits.
+    assert ulp_distance(np.array([-0.0], np.float32),
+                        np.array([0.0], np.float32)) == 1
+
+
+def test_ulp_distance_fp16_and_dtype_guard():
+    a = np.array([1.0], np.float16)
+    assert ulp_distance(a, np.nextafter(a, np.float16(2.0))) == 1
+    with pytest.raises(ValueError):
+        ulp_distance(a, a.astype(np.float32))
+    with pytest.raises(ValueError):
+        ulp_distance(np.array([1], np.int32), np.array([1], np.int32))
+
+
+# -- compare: the divergence report -------------------------------------------
+
+
+def test_compare_reports_first_divergent_leaf():
+    ref = {"params": {"w": np.ones((2, 3), np.float32)},
+           "step": np.int64(3)}
+    other = {"params": {"w": np.ones((2, 3), np.float32)},
+             "step": np.int64(3)}
+    other["params"]["w"] = np.nextafter(
+        other["params"]["w"], np.float32(2.0)
+    )
+    with pytest.raises(ParityViolation) as e:
+        ParityWatch(label="t", enabled=True).compare(ref, other)
+    msg = str(e.value)
+    assert "['params']['w']" in msg          # the leaf path
+    assert "dtype=float32" in msg
+    assert "6/6 element(s) differ" in msg
+    assert "max ULP distance 1" in msg
+    assert "first at index (0, 0)" in msg
+
+
+def test_compare_structure_and_dtype_and_shape_mismatch():
+    w = ParityWatch(enabled=True)
+    with pytest.raises(ParityViolation, match="STRUCTURE"):
+        w.compare({"a": np.ones(1)}, {"a": np.ones(1), "b": np.ones(1)})
+    with pytest.raises(ParityViolation, match="changed dtype"):
+        w.compare({"a": np.ones(1, np.float32)},
+                  {"a": np.ones(1, np.float64)})
+    with pytest.raises(ParityViolation, match="changed shape"):
+        w.compare({"a": np.ones(2)}, {"a": np.ones(3)})
+
+
+def test_compare_int_leaf_has_no_ulp_clause():
+    with pytest.raises(ParityViolation) as e:
+        ParityWatch(enabled=True).compare(
+            np.array([1, 2], np.int32), np.array([1, 3], np.int32)
+        )
+    assert "ULP" not in str(e.value)
+    assert "1/2 element(s) differ" in str(e.value)
+
+
+def test_compare_distinct_nan_bits_flagged():
+    # A bitwise gate must see through NaN == NaN being False AND NaN
+    # bit-pattern drift: two different NaN payloads are a divergence.
+    a = np.array([np.uint32(0x7FC00000)]).view(np.float32)
+    b = np.array([np.uint32(0x7FC00001)]).view(np.float32)
+    with pytest.raises(ParityViolation):
+        ParityWatch(enabled=True).compare(a, b)
+    ParityWatch(enabled=True).compare(a, a.copy())  # same bits: clean
+
+
+def test_tolerance_opt_out():
+    a = np.ones(4, np.float32)
+    b = a * np.float32(1.000001)
+    with pytest.raises(ParityViolation):
+        ParityWatch(enabled=True).compare(a, b)  # bitwise: differs
+    ParityWatch(rtol=1e-4, enabled=True).compare(a, b)  # opted out: ok
+    with pytest.raises(ParityViolation) as e:
+        ParityWatch(rtol=1e-9, atol=0.0, enabled=True).compare(a, b)
+    assert "rtol=1e-09" in str(e.value)  # the opt-out stays visible
+
+
+# -- check: the replay gate ---------------------------------------------------
+
+
+def test_check_runs_twice_and_returns_first():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return {"x": np.arange(4, dtype=np.float32)}
+
+    out = ParityWatch(enabled=True).check(fn)
+    assert len(calls) == 2
+    np.testing.assert_array_equal(out["x"], np.arange(4, dtype=np.float32))
+    calls.clear()
+    ParityWatch(runs=4, enabled=True).check(fn)
+    assert len(calls) == 4
+
+
+def test_check_flags_nondeterministic_callable():
+    rng = np.random.default_rng(7)
+
+    def fn():
+        return rng.standard_normal(8).astype(np.float32)
+
+    with pytest.raises(ParityViolation, match="run 2 vs run 1"):
+        ParityWatch(label="nondet", enabled=True).check(fn)
+
+
+def test_env_gate_disables_the_window(monkeypatch):
+    monkeypatch.setenv("MOOLIB_TPU_PARITYWATCH", "0")
+    assert not parity_enabled()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return np.ones(1)
+
+    ParityWatch().check(fn)  # enabled=None consults the env
+    assert len(calls) == 1  # single plain call, nothing compared
+    monkeypatch.setenv("MOOLIB_TPU_PARITYWATCH", "1")
+    assert parity_enabled()
+
+
+# -- the seeded A2C update, bitwise -------------------------------------------
+
+
+def test_seeded_a2c_update_bitwise_replay():
+    """The CI gate's core: one jitted IMPALA/A2C update from a fixed
+    seeded state must produce bit-identical params, opt state, AND
+    metrics when run twice in the same process (donate=False so both
+    runs read the same input buffers)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from moolib_tpu.learner import (ImpalaConfig, make_impala_train_step,
+                                    make_train_state)
+    from moolib_tpu.models import A2CNet
+
+    t_dim, b_dim, f_dim, a_dim = 4, 4, 5, 3
+    net = A2CNet(num_actions=a_dim, hidden_sizes=(32,))
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, f_dim)),
+                      jnp.zeros((1, 1), bool), ())
+    state = make_train_state(params, optax.sgd(1e-3))
+    step = make_impala_train_step(
+        net.apply, optax.sgd(1e-3), ImpalaConfig(), donate=False
+    )
+    ks = jax.random.split(jax.random.PRNGKey(1), 4)
+    batch = {
+        "obs": jax.random.normal(ks[0], (t_dim + 1, b_dim, f_dim),
+                                 jnp.float32),
+        "done": jax.random.bernoulli(ks[1], 0.1, (t_dim + 1, b_dim)),
+        "rewards": jax.random.normal(ks[2], (t_dim + 1, b_dim),
+                                     jnp.float32),
+        "actions": jax.random.randint(ks[3], (t_dim, b_dim), 0, a_dim),
+        "behavior_logits": jnp.zeros((t_dim, b_dim, a_dim), jnp.float32),
+        "core_state": (),
+    }
+
+    watch = ParityWatch(label="a2c-update", enabled=True)
+    state1, metrics = watch.check(
+        lambda: jax.tree_util.tree_map(
+            np.asarray, step(state, batch)
+        )
+    )
+    assert np.isfinite(metrics["total_loss"])
+    # And the update did something: params moved.
+    moved = any(
+        not np.array_equal(a, b)
+        for (_pa, a), (_pb, b) in zip(
+            flatten_with_paths(jax.tree_util.tree_map(np.asarray,
+                                                      state.params)),
+            flatten_with_paths(state1.params),
+        )
+    )
+    assert moved
+
+
+# -- allreduce arrival-order invariance ---------------------------------------
+
+
+def test_payloads_are_order_sensitive():
+    """Meta-check: the payloads the invariance test reduces MUST be
+    order-sensitive on the host too, or the cohort check would pass
+    vacuously (a symmetric payload hides an order bug)."""
+    d = order_sensitive_payloads(4)
+    fixed = tree_fixed_fold(d)                   # (d0 + (d1 + d3)) + d2
+    arrival = ((d[2] + d[0]) + (d[1] + d[3]))    # one arrival reordering
+    assert fixed.tobytes() != arrival.tobytes()
+    # ...and ParityWatch.compare is the instrument that sees it.
+    with pytest.raises(ParityViolation, match="ULP distance"):
+        ParityWatch(label="order", enabled=True).compare(fixed, arrival)
+
+
+@pytest.mark.integration
+def test_allreduce_arrival_order_invariance():
+    """A real 4-peer loopback cohort, one reduce round per arrival
+    permutation: every peer in every round must get the SAME BITS, and
+    those bits must equal the documented fixed fold — node i merges
+    own ⊕ subtree(2i+1) ⊕ subtree(2i+2) in child-index order over the
+    actual membership order (allreduce_order_parity compares each
+    result against tree_fixed_fold internally and raises on any
+    divergence)."""
+    payloads = order_sensitive_payloads(4)
+    result = allreduce_order_parity(n_peers=4, payloads=payloads)
+    # The returned reference IS the host-side contract fold for some
+    # membership ordering of these payloads: same multiset of inputs,
+    # finite, and the right shape.
+    assert result.shape == payloads[0].shape
+    assert result.dtype == np.float32
+    assert np.isfinite(result).all()
+    # Sanity anchor independent of ordering: the fp64 sum of the fp32
+    # results must be close to the fp64 sum of inputs.
+    np.testing.assert_allclose(
+        result.astype(np.float64),
+        sum(p.astype(np.float64) for p in payloads),
+        rtol=1e-4, atol=1e-2,
+    )

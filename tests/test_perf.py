@@ -90,8 +90,9 @@ def test_load_trends_raises_on_corrupt_line(tmp_path):
 def test_suite_catalogue_covers_the_cpu_proxies():
     # The ISSUE 7 catalogue plus ISSUE 8's serving rows, ISSUE 12's
     # env-tier recovery row, ISSUE 14's shm transport-lane row, ISSUE
-    # 15's durable-state replication row, and ISSUE 17's hotwatch-gated
-    # learner e2e row: every named proxy present, every entry carrying a
+    # 15's durable-state replication row, ISSUE 17's hotwatch-gated
+    # learner e2e row, and ISSUE 18's paritywatch gate-cost row: every
+    # named proxy present, every entry carrying a
     # reproduce-command-compatible name.
     assert set(CPU_PROXY_SUITE) == {
         "rpc_echo_latency_s", "rpc_payload_gbps", "rpc_shm_payload_gbps",
@@ -100,6 +101,7 @@ def test_suite_catalogue_covers_the_cpu_proxies():
         "serial_encode_gbps", "serial_decode_gbps",
         "statestore_replicate_gbps", "serving_qps",
         "serving_p99_latency_s", "e2e_learner_step_s",
+        "parity_check_s",
     }
 
 

@@ -12,7 +12,7 @@ fmt=text
 if [ -n "${GITHUB_ACTIONS:-}" ]; then fmt=gha; fi
 
 echo "== moolint: moolib_tpu/ =="
-# --rule-times: per-rule wall-time for the 9-family suite rides the run
+# --rule-times: per-rule wall-time for the 10-family suite rides the run
 # that lints the tree anyway, so a rule that goes quadratic is caught by
 # eye here before it is caught by the test-suite budget. (The hot family
 # memoizes its cross-module jit-binding resolution on the lint context,
@@ -68,6 +68,21 @@ echo "== hotwatch gate =="
 # caught twice: here as a named assertion, there as a trend row.
 timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_hotwatch.py -q -p no:cacheprovider
+
+echo "== parity gate =="
+# numlint's dynamic mirror (docs/analysis.md, "numlint"): ParityWatch
+# runs the seeded A2C update twice in-process (donate=False) and
+# demands bit-identical params/opt-state/metrics, with the divergence
+# report itself pinned (first divergent leaf path, dtype, ULP
+# distance — what a numerics bisect runs on). The integration row
+# spins a real 4-peer loopback cohort and permutes peer arrival order:
+# every peer in every round must return the SAME BITS, equal to the
+# documented fixed fold in rpc/group.py (node i merges own ⊕
+# subtree(2i+1) ⊕ subtree(2i+2) in child-index order) — pinning the
+# reduction-order contract as executable spec, with order-SENSITIVE
+# payloads so a symmetric input can't make the check vacuous.
+timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_parity.py -q -p no:cacheprovider
 
 echo "== chaos + serving smoke =="
 # Bounded seeded fault-injection pass (12 scenarios, well under 60s,

@@ -78,6 +78,9 @@ MODULES = [
     ("moolib_tpu.testing.hotwatch", "dynamic transfer/compile gate: "
      "counted D2H/H2D window with staged-copy accounting and compile "
      "flatness (hotlint's runtime mirror)"),
+    ("moolib_tpu.testing.paritywatch", "bitwise-replay gate: N-run "
+     "pytree parity with first-divergent-leaf/ULP reporting + allreduce "
+     "arrival-order invariance (numlint's runtime mirror)"),
     ("moolib_tpu.serving", "fault-tolerant serving tier: replicated "
      "inference behind a load-aware router"),
     ("moolib_tpu.serving.admission", "bounded admission queues, "
@@ -124,8 +127,11 @@ MODULES = [
     ("moolib_tpu.utils.nest", "nested-structure utilities"),
     ("moolib_tpu.analysis", "moolint: async-RPC safety, JAX trace hygiene, "
      "sharding/collective consistency, RPC round-balance, race/lock-order, "
-     "resource-lifecycle + hot-path device/host discipline static analysis "
-     "(tier-1 enforced)"),
+     "resource-lifecycle, hot-path device/host discipline + "
+     "numerics/determinism static analysis (tier-1 enforced)"),
+    ("moolib_tpu.analysis.rules_num", "numlint rule family: PRNG key "
+     "discipline, seeded randomness, fp32 accumulation, dtype promotion, "
+     "iteration-order determinism"),
     ("moolib_tpu.bench.harness", "perfwatch harness: timing protocol + "
      "unified result schema"),
     ("moolib_tpu.bench.suite", "CPU-proxy perf suite (runs on every PR, "

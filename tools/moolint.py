@@ -8,6 +8,7 @@ Usage:
     python tools/moolint.py --baseline-update     # re-grandfather findings
     python tools/moolint.py --baseline-stats      # burn-down counters
     python tools/moolint.py --list-rules
+    python tools/moolint.py --explain prng-key-reuse   # doc + example pair
     python tools/moolint.py --format=json moolib_tpu/   # (--json: alias)
     python tools/moolint.py --format=gha moolib_tpu/    # ::error annotations
 
@@ -62,6 +63,13 @@ def main(argv=None) -> int:
                          "reached 0 and the baseline must stay empty")
     ap.add_argument("--list-rules", action="store_true",
                     help="list registered rules and exit")
+    ap.add_argument("--explain", action="append", default=None,
+                    metavar="RULE",
+                    help="print a rule's doc, bad/good example pair and "
+                         "suppression grammar, sourced from the rule "
+                         "class itself (repeatable / comma lists; "
+                         "fnmatch globs like 'num-*' explain a family); "
+                         "unknown names are an error")
     ap.add_argument("--diff", metavar="REF", default=None,
                     help="lint only files changed vs the git REF "
                          "(committed, staged, unstaged, and untracked "
@@ -102,6 +110,11 @@ def main(argv=None) -> int:
               f"--format={args.fmt}", file=sys.stderr)
         return 2
     args.as_json = args.fmt == "json"
+
+    if args.explain:
+        patterns = [r for chunk in args.explain
+                    for r in chunk.split(",") if r]
+        return explain_rules(patterns, as_json=args.as_json)
 
     if args.list_rules:
         for rule in all_rules():
@@ -259,6 +272,57 @@ def _changed_lint_files(ref: str, requested):
         print(f"moolint: error: {e}", file=sys.stderr)
         return None
     return [REPO_ROOT / rel for rel in scoped if rel in changed]
+
+
+def explain_rules(patterns, as_json=False) -> int:
+    """``--explain``: everything printed comes off the Rule class (name,
+    family, description, the class docstring as the long-form doc, the
+    example pair, the suppression grammar) so the CLI can never drift
+    from the implementation — docs link here instead of duplicating.
+    Patterns use the same fnmatch semantics as --only (a glob matches
+    the rule name or its family-qualified ``<family>-<name>`` form);
+    a pattern matching nothing is exit-code-2 error, not silence."""
+    import inspect
+
+    from moolib_tpu.analysis.engine import _select_rules
+
+    try:
+        selected = _select_rules(None, patterns)
+    except LintError as e:
+        print(f"moolint: error: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps([{
+            "name": r.name,
+            "family": r.family,
+            "description": r.description,
+            "doc": inspect.cleandoc(r.__doc__ or ""),
+            "example_bad": r.example_bad,
+            "example_good": r.example_good,
+            "suppression": r.suppression_grammar(),
+        } for r in selected], indent=1))
+        return 0
+    for i, r in enumerate(selected):
+        if i:
+            print()
+        title = f"{r.name}" + (f"  [family: {r.family}]" if r.family else "")
+        print(title)
+        print("=" * len(title))
+        print(r.description)
+        doc = inspect.cleandoc(r.__doc__ or "")
+        if doc:
+            print()
+            print(doc)
+        if r.example_bad:
+            print("\nflagged:")
+            for line in r.example_bad.splitlines():
+                print(f"    {line}")
+        if r.example_good:
+            print("\nclean:")
+            for line in r.example_good.splitlines():
+                print(f"    {line}")
+        print(f"\nsuppression: {r.suppression_grammar()}")
+    return 0
 
 
 def _print_rule_times(timings: dict):
