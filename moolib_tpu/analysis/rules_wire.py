@@ -91,6 +91,11 @@ _BUILTIN_ENDPOINTS = (
     # literal call sites in tools/tests must resolve even when the
     # defining module is outside the lint run.
     "StateStoreService::" + WILDCARD,
+    # The fleet wire family (moolib_tpu/fleet/controller.py): every
+    # fleet role peer defines fleet.ping/fleet.role_info, the
+    # controller defines fleet.status — same out-of-run resolution
+    # problem as the serving family for tools/tests call sites.
+    "fleet." + WILDCARD,
 )
 
 

@@ -129,6 +129,10 @@ CPU_PROXY_BUDGETS: Dict[str, Budget] = {
         quantiles=[("serving_request_seconds", "", {"p99": 5.0})],
     ),
     "serving_p99_latency_s": Budget(value_max=5.0),
+    # One canary rollout (0.5s settle floor + publish/gate machinery):
+    # sub-second on a quiet host; the ceiling catches a wedged publish
+    # or a gate loop that stopped ticking, not a noisy neighbour.
+    "fleet_rollout_s": Budget(value_max=10.0),
 }
 
 

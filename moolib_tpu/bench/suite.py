@@ -41,6 +41,13 @@ benchmark                       hot path it guards
 ``serving_p99_latency_s``       admission, dynamic batching in jit) —
                                 throughput and the tail the robustness
                                 layer keeps bounded
+``fleet_rollout_s``             fleet-tier control-plane latency: one
+                                zero-downtime canary rollout (canary
+                                publish, weighted settle, promote) through
+                                a spec-materialized cohort under
+                                closed-loop load — floored by the fixed
+                                settle window, so the row watches the
+                                machinery around it
 ``e2e_learner_step_s``          steady-state fused IMPALA train step under
                                 a hotwatch window — ``extra`` proves zero
                                 synchronous D2H and flat compile counts
@@ -110,6 +117,11 @@ TREND_TOLERANCE = {
     # the shared container).
     "serving_qps": 0.5,
     "serving_p99_latency_s": 0.65,
+    # One canary rollout end to end: floored by the fixed settle window,
+    # but the machinery around it (publish acks, gate evaluation ticks,
+    # threaded load) rides the same shared-container scheduling noise as
+    # the serving rows.
+    "fleet_rollout_s": 0.65,
     # XLA-compiled step on the shared CPU: compile cache is warm but the
     # matmul-heavy step competes with every neighbour for the one core.
     "e2e_learner_step_s": 0.5,
@@ -782,6 +794,58 @@ def bench_serving_p99(smoke: bool) -> BenchResult:
     )
 
 
+# -- fleet tier ---------------------------------------------------------------
+
+
+def bench_fleet_rollout(smoke: bool) -> BenchResult:
+    """Wall time of one zero-downtime canary rollout (canary publish ->
+    weighted settle -> promote) through a ``FleetSpec.small`` cohort
+    under closed-loop load. The 0.5s settle window is a constant floor;
+    the row watches the control-plane machinery around it — spec
+    materialization is excluded, dropped requests turn the row into an
+    error row."""
+    from ..fleet import FleetSpec
+    from ..testing.scenarios import FleetHarness, _run_load
+    from ..utils import set_log_level
+
+    set_log_level("error")
+    settle_s = 0.5
+    spec = FleetSpec.small(replicas=3, routers=1, learners=0,
+                           env_workers=0, settle_s=settle_s)
+    n_requests = 160 if smoke else 640
+    harness = FleetHarness(spec, standby=False)
+    lock = threading.Lock()
+    try:
+        harness.wait_routable(3)
+        ctl = harness.controller
+        ctl.publish_model({"scale": np.float32(3.0)}, 2)
+        outcomes: list = []
+        threads = _run_load(harness.router, n_requests, 4, 8.0,
+                            outcomes, lock)
+        t0 = clock()
+        state = ctl.start_rollout(version=2, wait=True)
+        dt = clock() - t0
+        for t in threads:
+            t.join(timeout=120)
+        if state != "promoted":
+            raise RuntimeError(f"rollout ended {state}, not promoted")
+        bad = [r for r in outcomes if r[0] != "ok"]
+        if bad:
+            raise RuntimeError(
+                f"rollout dropped {len(bad)} accepted requests "
+                f"(first: {bad[:1]})"
+            )
+        return _result(
+            "fleet_rollout_s", dt, "s", "lower", smoke,
+            stats={"settle_s": settle_s, "requests": len(outcomes)},
+            telemetry=ctl.rpc.telemetry.snapshot(),
+            extra={"replicas": 3,
+                   "canary_weight": spec.rollout.canary_weight},
+        )
+    finally:
+        harness.close()
+
+
 # -- learner e2e steady state -------------------------------------------------
 
 
@@ -937,6 +1001,7 @@ CPU_PROXY_SUITE: Dict[str, Callable[[bool], BenchResult]] = {
     "statestore_replicate_gbps": bench_statestore_replicate,
     "serving_qps": bench_serving_qps,
     "serving_p99_latency_s": bench_serving_p99,
+    "fleet_rollout_s": bench_fleet_rollout,
     "e2e_learner_step_s": bench_e2e_learner_step,
     "parity_check_s": bench_parity_check,
 }

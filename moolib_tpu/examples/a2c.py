@@ -75,6 +75,22 @@ class A2CConfig:
     log_interval_steps: int = 4_000
     seed: int = 0
 
+    @classmethod
+    def from_fleet_spec(cls, spec, **overrides) -> "A2CConfig":
+        """Derive the launch shape from a declarative
+        :class:`~moolib_tpu.fleet.spec.FleetSpec` (docs/fleet.md): the
+        env tier's worker count and the learner cohort's
+        quorum/straggler/group knobs come from the spec — one validated
+        value drives both the fleet controller and the training
+        example. Everything else keeps its default unless overridden."""
+        cfg = cls(
+            num_processes=max(spec.env_workers.n, 1),
+            min_quorum=spec.learners.min_quorum,
+            straggler_timeout=spec.learners.straggler_timeout_s,
+            group=spec.learners.group,
+        )
+        return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
 
 def a2c_loss(params, apply_fn, batch, config):
     """A2C loss on a time-major unroll: n-step bootstrapped returns,

@@ -1,0 +1,30 @@
+"""Fleet tier: declarative cohort control for every survivability piece.
+
+``spec`` (the cohort shape as a validated, JSON-round-trippable value)
+-> ``controller`` (materialize + supervise + adopt) -> ``rollout``
+(canary / SLO gates / promote-or-rollback). See docs/fleet.md.
+"""
+
+from .controller import AdoptError, Cohort, Controller, RoleHandle
+from .rollout import Rollout, RolloutError
+from .spec import (BrokerSpec, EnvSpec, FleetSpec, LearnerSpec,
+                   RolloutSpec, ServingSpec, SpecError, StateStoreSpec,
+                   SupervisionSpec)
+
+__all__ = [
+    "AdoptError",
+    "BrokerSpec",
+    "Cohort",
+    "Controller",
+    "EnvSpec",
+    "FleetSpec",
+    "LearnerSpec",
+    "RoleHandle",
+    "Rollout",
+    "RolloutError",
+    "RolloutSpec",
+    "ServingSpec",
+    "SpecError",
+    "StateStoreSpec",
+    "SupervisionSpec",
+]

@@ -59,6 +59,13 @@ KINDS: Dict[str, Tuple[str, ...]] = {
     "ss_write_failure": ("store", "version", "op", "error"),
     "ss_restore": ("store", "version", "holders", "refetched"),
     "ss_gc": ("store", "version"),
+    # Fleet controller + rollout (moolib_tpu/fleet/)
+    "fleet_spawn": ("fleet", "role", "kind", "backend"),
+    "fleet_restart": ("fleet", "role", "strikes"),
+    "fleet_down": ("fleet", "role", "strikes"),
+    "fleet_adopt": ("fleet", "controller", "epoch", "roles"),
+    "fleet_rollout": ("fleet", "state", "version"),
+    "fleet_slo_breach": ("fleet", "gate", "value", "bound"),
     # chaosnet injections (moolib_tpu/testing/chaos.py) and the incident
     # machinery itself (moolib_tpu/flightrec/capture.py)
     "chaos": ("kind", "action", "peer", "endpoint"),

@@ -35,6 +35,7 @@ from random import Random
 from typing import Any, Dict, List, Optional
 
 from ..rpc import Rpc, RpcError
+from ..telemetry import RollingQuantile
 from ..utils import get_logger
 from .admission import DeadlineExceeded, Overloaded, error_kind
 from .health import CircuitBreaker, ReplicaHealth
@@ -100,6 +101,15 @@ class Router:
         self._rng = Random(seed)
         self._lock = threading.Lock()
         self._closed = False
+        # Canary slice (moolib_tpu.fleet.rollout): a replica subset that
+        # receives ``weight`` of the traffic, with per-slice outcome
+        # stats so the rollout's SLO gates read the CURRENT regime
+        # (RollingQuantile, not the forever-cumulative histogram). All
+        # three fields move together under ``_lock``.
+        self._canary: frozenset = frozenset()
+        self._canary_weight = 0.0
+        self._slice_stats = self._fresh_slice_stats()
+        self._drain_hooks: List[Any] = []
 
         self._health: Dict[str, ReplicaHealth] = {}
         for i, name in enumerate(replicas):
@@ -168,24 +178,54 @@ class Router:
 
     def routable(self) -> List[str]:
         now = time.monotonic()
-        return [n for n, h in self._health.items() if h.routable(now)]
+        return [n for n, h in list(self._health.items())
+                if h.routable(now)]
 
     def _pick(self, exclude) -> Optional[str]:
         """Least-loaded routable replica not in ``exclude`` (falls back
         to already-tried ones rather than refusing outright — with every
         candidate tried once, a second visit beats an error while budget
-        remains). Half-open breakers hand out one trial at dispatch."""
+        remains). Half-open breakers hand out one trial at dispatch.
+
+        With a canary slice installed, the traffic split is decided
+        FIRST (one weighted coin per pick), then least-loaded within the
+        chosen slice — but untried-beats-tried stays dominant and each
+        slice falls back to the other before refusing: a canary made of
+        corpses must degrade to stable dispatch, never to ``Overloaded``
+        (the zero-downtime half of the rollout contract)."""
         now = time.monotonic()
+        with self._lock:
+            canary, weight = self._canary, self._canary_weight
+        if canary:
+            # None marks the stable slice: membership is "not in canary"
+            # so replicas never fall in a gap between the two pools.
+            preferred = canary if self._rng.random() < weight else None
+            slices = (preferred, self._other(preferred, canary))
+        else:
+            slices = (None,)
         for pool in (exclude, None):
-            cands = [
-                (h.load_key(), self._rng.random(), n)
-                for n, h in self._health.items()
-                if h.routable(now) and (pool is None or n not in pool)
-            ]
-            for _key, _jit, name in sorted(cands):
-                if self._health[name].breaker.try_acquire(time.monotonic()):
-                    return name
+            for slc in slices:
+                cands = [
+                    (h.load_key(), self._rng.random(), n)
+                    for n, h in list(self._health.items())
+                    if h.routable(now) and (pool is None or n not in pool)
+                    and self._in_slice(n, slc, canary)
+                ]
+                for _key, _jit, name in sorted(cands):
+                    if self._health[name].breaker.try_acquire(
+                            time.monotonic()):
+                        return name
         return None
+
+    @staticmethod
+    def _other(preferred, canary):
+        return None if preferred is canary else canary
+
+    @staticmethod
+    def _in_slice(name, slc, canary) -> bool:
+        if slc is None:  # stable slice (or no canary at all)
+            return not canary or name not in canary
+        return name in slc
 
     def infer(self, x: Any, *, budget_s: Optional[float] = None) -> Any:
         """Route one request; returns the replica's reply or raises an
@@ -241,12 +281,17 @@ class Router:
             dt = time.monotonic() - t0
             if err is None:
                 h.record_call(True, time.monotonic(), latency_s=dt)
+                self._record_slice(name, True, dt)
                 if self._tel.on:
                     self._m_ok.inc()
                     self._m_latency.observe(time.monotonic() - t_start)
                     self._dispatch_counter(name).inc()
                 return result
             kind = error_kind(err)
+            if kind not in ("overloaded", "deadline"):
+                # Admission refusals are load signals, not failures —
+                # only real failures feed the slice error-rate gate.
+                self._record_slice(name, False, dt)
             last_exc = err
             tried.add(name)
             if kind == "deadline" and attempt_budget >= remaining - 1e-3:
@@ -288,21 +333,126 @@ class Router:
         for load generators and pipelined clients."""
         return self._pool.submit(self.infer, x, budget_s=budget_s)
 
+    # -- canary slice (fleet rollout) ----------------------------------------
+
+    @staticmethod
+    def _fresh_slice_stats():
+        return {s: {"ok": 0, "errors": 0, "lat": RollingQuantile(256)}
+                for s in ("canary", "stable")}
+
+    def _record_slice(self, name: str, ok: bool, latency_s: float) -> None:
+        lat = None
+        with self._lock:
+            key = "canary" if name in self._canary else "stable"
+            s = self._slice_stats[key]
+            if ok:
+                s["ok"] += 1
+                lat = s["lat"]
+            else:
+                s["errors"] += 1
+        if lat is not None:
+            # Observed OUTSIDE the router lock (RollingQuantile has its
+            # own): a concurrent set_canary may have swapped the stats,
+            # in which case this sample lands in the discarded window —
+            # exactly the reset semantics the SLO gates want.
+            lat.observe(latency_s)
+
+    def set_canary(self, replicas, weight: float) -> None:
+        """Install a canary slice: ``replicas`` (known names) carry
+        ``weight`` of the traffic from the next pick on. Installing a
+        slice resets the per-slice stats — the SLO gates must judge the
+        canary regime, not history — and re-resolves atomically: there
+        is never a pick that sees the new weight with the old slice."""
+        names = frozenset(replicas)
+        unknown = names - set(self._health)
+        if unknown:
+            raise ValueError(f"unknown replica(s) {sorted(unknown)}")
+        if not names:
+            raise ValueError("canary slice must name at least one replica")
+        weight = float(weight)
+        if not 0.0 < weight <= 1.0:
+            raise ValueError(f"weight must be in (0, 1], got {weight!r}")
+        with self._lock:
+            self._canary = names
+            self._canary_weight = weight
+            self._slice_stats = self._fresh_slice_stats()
+        if self._tel.on:
+            self._tel.registry.gauge(
+                "serving_canary_weight", service=self.service
+            ).set(weight)
+
+    def clear_canary(self) -> None:
+        """Remove the canary slice (promote/rollback epilogue): all
+        traffic is least-loaded across the whole fleet again."""
+        with self._lock:
+            self._canary = frozenset()
+            self._canary_weight = 0.0
+        if self._tel.on:
+            self._tel.registry.gauge(
+                "serving_canary_weight", service=self.service
+            ).set(0.0)
+
+    def canary(self):
+        """The installed slice as ``(names, weight)`` —
+        ``(frozenset(), 0.0)`` when none."""
+        with self._lock:
+            return self._canary, self._canary_weight
+
+    def slice_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-slice outcome stats since the last ``set_canary``:
+        ``{"canary"|"stable": {n, ok, errors, p99_s}}`` — the inputs the
+        rollout's SLO gates are derived from (docs/fleet.md)."""
+        with self._lock:
+            stats = {k: dict(ok=s["ok"], errors=s["errors"], lat=s["lat"])
+                     for k, s in self._slice_stats.items()}
+        out = {}
+        for key, s in stats.items():
+            out[key] = {
+                "n": s["ok"] + s["errors"], "ok": s["ok"],
+                "errors": s["errors"], "p99_s": s["lat"].quantile(0.99),
+            }
+        return out
+
     # -- fleet management ----------------------------------------------------
 
+    def forget_replica(self, name: str) -> None:
+        """Drop ``name`` from the fleet view entirely (the controller's
+        permanent-down path): no more probes, no more dispatch — the
+        router routes around the corpse instead of re-counting its
+        probe misses forever. Unknown names are a no-op so forget after
+        forget is idempotent."""
+        with self._lock:
+            self._canary = self._canary - {name}
+            if not self._canary:
+                self._canary_weight = 0.0
+        self._health.pop(name, None)
+
+    def add_drain_hook(self, fn) -> None:
+        """Register ``fn(name)`` to run after ``drain_replica(name)``
+        succeeds — the seam the fleet controller uses to sequence
+        restarts behind graceful drains."""
+        with self._lock:
+            self._drain_hooks.append(fn)
+
     def publish_weights(self, params: Any, version: int, *,
-                        timeout_s: float = 30.0) -> Dict[str, bool]:
+                        timeout_s: float = 30.0,
+                        replicas=None) -> Dict[str, bool]:
         """Hot-swap the model on every replica (draining ones included —
-        they still serve admitted work). Returns per-replica success; a
-        dark replica simply reports False (it will be told again by the
-        next publisher once it returns — version monotonicity is the
-        publisher's concern, not the wire's)."""
+        they still serve admitted work), or on the ``replicas`` subset
+        when given (the canary publish path). Returns per-replica
+        success; a dark replica simply reports False (it will be told
+        again by the next publisher once it returns — version
+        monotonicity is the publisher's concern, not the wire's)."""
+        targets = list(self._health) if replicas is None else list(replicas)
+        unknown = set(targets) - set(self._health)
+        if unknown:
+            raise ValueError(f"unknown replica(s) {sorted(unknown)}")
         acks: Dict[str, bool] = {}
         futs = {
             name: self.rpc.call_with_deadline(
                 name, f"{self.service}.load", timeout_s, params, version
             )
-            for name in self._health
+            for name in targets
         }
         for name, fut in futs.items():
             try:
@@ -327,18 +477,25 @@ class Router:
         )
         try:
             reply = fut.result(timeout=timeout_s + 2.0)
-            return bool(reply and reply.get("drained"))
+            drained = bool(reply and reply.get("drained"))
         except (asyncio.CancelledError, concurrent.futures.CancelledError):
             raise  # never swallow task cancellation
         except (RpcError, TimeoutError) as e:
             log.warning("drain of %s failed: %s", name, e)
             return False
+        if drained:
+            with self._lock:
+                hooks = list(self._drain_hooks)
+            for fn in hooks:
+                fn(name)
+        return drained
 
     def stats(self) -> Dict[str, Any]:
         now = time.monotonic()
         return {
             "service": self.service,
-            "replicas": {n: h.state(now) for n, h in self._health.items()},
+            "replicas": {n: h.state(now)
+                         for n, h in list(self._health.items())},
             "routable": self.routable(),
         }
 

@@ -46,6 +46,10 @@ __all__ = [
     "scenario_envpool_worker_kill",
     "scenario_envpool_wedge",
     "scenario_envpool_poison",
+    "FleetHarness",
+    "scenario_fleet_controller_kill",
+    "scenario_fleet_bad_canary",
+    "scenario_fleet_role_crashloop",
     "SCENARIOS",
 ]
 
@@ -2004,6 +2008,351 @@ def _locked_cond(cond, lock):
         return cond()
 
 
+class FleetHarness:
+    """Spec-driven fleet-in-a-box: one controller (plus an optional
+    standby sharing the cohort), and every role the spec names —
+    brokers, learners, env workers, replicas, routers — all in-process
+    over loopback on OS-assigned ports. Scales the MiniCluster idea to
+    fleet shape (30+ peers on one host; pinned in tests/test_fleet.py)
+    and is the substrate the fleet chaos scenarios drive."""
+
+    def __init__(self, spec=None, *, standby: bool = True, seed: int = 0,
+                 model=None, params=None, version: int = 1,
+                 failover_after_s: float = 0.5, incident_dir=None):
+        from ..fleet import Controller, FleetSpec
+
+        self.spec = (spec if spec is not None
+                     else FleetSpec.small(replicas=3, routers=1))
+        self.controller = Controller(
+            self.spec, name="ctl0", model=model, params=params,
+            version=version, seed=seed, incident_dir=incident_dir,
+        )
+        self.controller.materialize()
+        self.cohort = self.controller.cohort
+        self.standby = None
+        if standby:
+            self.standby = Controller(
+                self.spec, cohort=self.cohort, name="ctl1", standby=True,
+                model=model, params=params, version=version,
+                seed=seed + 1, failover_after_s=failover_after_s,
+                incident_dir=incident_dir,
+            )
+        self._closed = False
+
+    @property
+    def router(self):
+        """The fleet's first live router object (reads the shared
+        cohort, so it survives a controller kill)."""
+        return self.controller.router()
+
+    def handle(self, name: str):
+        with self.cohort.lock:
+            return self.cohort.roles[name]
+
+    def role_rpcs(self):
+        with self.cohort.lock:
+            return [h.rpc for h in self.cohort.roles.values()
+                    if h.rpc is not None]
+
+    def all_rpcs(self):
+        rpcs = [self.controller.rpc]
+        if self.standby is not None:
+            rpcs.append(self.standby.rpc)
+        return rpcs + self.role_rpcs()
+
+    def wait_routable(self, n: int, timeout: float = 15.0):
+        router = self.router
+        assert router is not None, "fleet spec has no router"
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(router.routable()) >= n:
+                return
+            time.sleep(0.02)
+        raise AssertionError(
+            f"fleet never reached {n} routable replicas: "
+            + str(router.stats())
+        )
+
+    def close(self):
+        """Idempotent full teardown: controllers first (their threads
+        reference the roles), then every role via the cohort."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.standby is not None:
+            self.standby.close()
+        self.controller.close()
+        self.cohort.close()
+
+
+def _fleet_model(params, x):
+    """The fleet scenarios' model: a numpy scale with a poison switch,
+    so a "bad build" is just a params publish away."""
+    if params.get("poison"):
+        raise RuntimeError("poisoned canary build")
+    return x * params["scale"]
+
+
+def scenario_fleet_controller_kill(seed: int, *, requests: int = 240,
+                                   post_requests: int = 120,
+                                   concurrency: int = 4,
+                                   budget_s: float = 8.0) -> Dict[str, int]:
+    """SIGKILL the primary controller mid-rollout (mid-settle): the
+    standby adopts behind the epoch fence once the cohort heartbeat goes
+    stale, resumes the in-flight canary with a fresh settle window, and
+    the healthy canary completes (promoted — never orphaned). No
+    accepted request is dropped across the handoff, a second adopt by
+    the winner is a fenced no-op, and the injected-event log is
+    identical for identical seeds (the kill is the only injection)."""
+    from ..fleet import FleetSpec
+
+    spec = FleetSpec.small(replicas=3, routers=1, settle_s=2.0)
+    harness = FleetHarness(spec, standby=True, seed=seed,
+                           model=_fleet_model,
+                           params={"scale": np.float32(2.0)})
+    plan = FaultPlan(seed)
+    net = ChaosNet(plan, harness.all_rpcs())
+    lock = threading.Lock()
+    try:
+        harness.wait_routable(3)
+        primary, standby = harness.controller, harness.standby
+        primary.publish_model({"scale": np.float32(3.0)}, 2)
+        outcomes: list = []
+        threads = _run_load(harness.router, requests, concurrency,
+                            budget_s, outcomes, lock)
+        primary.start_rollout(version=2, wait=False)
+        _await(lambda: (harness.cohort.rollout or {}).get("state")
+               == "settling", 10.0, "rollout never reached settling",
+               lock=harness.cohort.lock)
+        # The injected SIGKILL: connections die abruptly, the
+        # supervisor stops without any cleanup — the heartbeat stales.
+        net.kill_conns(primary.rpc)
+        primary.kill()
+        _await(lambda: harness.cohort.epoch == 2
+               and harness.cohort.controller == "ctl1", 15.0,
+               "standby never adopted the fleet",
+               lock=harness.cohort.lock)
+        _await(lambda: (harness.cohort.rollout or {}).get("state")
+               in ("promoted", "rolled_back"), 15.0,
+               "resumed rollout never reached a terminal state",
+               lock=harness.cohort.lock)
+        with harness.cohort.lock:
+            state = harness.cohort.rollout["state"]
+            version = harness.cohort.current_version
+        assert state == "promoted", (
+            f"a healthy canary must promote after adoption, got {state}"
+        )
+        assert version == 2, version
+        # The fence: re-adopting the epoch you hold is a no-op (it can
+        # never double-spawn), and the adopter is the fenced controller.
+        again = standby.adopt()
+        assert again == {"already": True, "epoch": 2}, again
+        assert standby.status()["fenced"], "adopter is not fenced"
+        # The canary slice was cleared by the promote.
+        members, weight = harness.router.canary()
+        assert members == frozenset() and weight == 0.0, (members, weight)
+        # Every replica ends on the new version.
+        for h in (harness.handle(f"{spec.name}-rep{i}") for i in range(3)):
+            assert h.obj is not None and h.obj.version == 2, h.summary()
+        for t in threads:
+            t.join(timeout=requests * (budget_s + 5))
+            assert not t.is_alive(), (
+                "load worker hung across the controller handoff"
+            )
+        bad = [r for r in outcomes if r[0] != "ok"]
+        assert not bad, (
+            f"accepted requests dropped across controller loss: {bad[:3]}"
+        )
+        # Service continues under the adopted controller too.
+        post: list = []
+        for t in _run_load(harness.router, post_requests, concurrency,
+                           budget_s, post, lock):
+            t.join(timeout=60)
+            assert not t.is_alive(), "post-adoption load worker hung"
+        assert all(k == "ok" for k, _lat, _v in post), (
+            f"post-adoption failures: "
+            f"{[r for r in post if r[0] != 'ok'][:3]}"
+        )
+        # Replay determinism: the kill is the only injection.
+        assert [e.kind for e in plan.events] == ["conn_kill"], (
+            f"unexpected injected-event log: {plan.events}"
+        )
+        plan.verify_telemetry()
+        return plan.summary()
+    finally:
+        net.detach_all()
+        harness.close()
+
+
+def scenario_fleet_bad_canary(seed: int, *, requests: int = 300,
+                              concurrency: int = 4,
+                              budget_s: float = 8.0) -> Dict[str, int]:
+    """Roll out a poisoned build under load: the canary slice's error
+    rate breaches the SLO gate, auto-rollback fires within the settle
+    window (not at its end), zero accepted requests are dropped (canary
+    victims fail fast and are retried on the stable slice), every
+    replica is restored to the exact prior version, and the incident
+    bundle re-validates from disk with the breach and the rollback
+    transition on one merged timeline."""
+    import tempfile
+
+    from ..fleet import FleetSpec
+    from ..flightrec import load_bundle, merge_bundles
+
+    spec = FleetSpec.small(replicas=3, routers=1, settle_s=3.0)
+    with tempfile.TemporaryDirectory() as tmp:
+        harness = FleetHarness(spec, standby=False, seed=seed,
+                               model=_fleet_model,
+                               params={"scale": np.float32(2.0)},
+                               incident_dir=tmp)
+        plan = FaultPlan(seed)
+        net = ChaosNet(plan, harness.all_rpcs())
+        lock = threading.Lock()
+        try:
+            harness.wait_routable(3)
+            ctl = harness.controller
+            ctl.publish_model({"scale": np.float32(9.0), "poison": True},
+                              2)
+            rollout = ctl.start_rollout(version=2, wait=False)
+            _await(lambda: rollout.state == "settling", 10.0,
+                   "rollout never reached settling")
+            t_settling = time.monotonic()
+            outcomes: list = []
+            threads = _run_load(harness.router, requests, concurrency,
+                                budget_s, outcomes, lock)
+            _await(lambda: rollout.state in ("promoted", "rolled_back"),
+                   spec.rollout.settle_s + 10.0,
+                   "rollout never reached a terminal state")
+            took = time.monotonic() - t_settling
+            assert rollout.state == "rolled_back", rollout.state
+            assert took < spec.rollout.settle_s, (
+                f"rollback took {took:.2f}s — the gate should breach "
+                f"within the {spec.rollout.settle_s}s settle window, "
+                "not at its close"
+            )
+            assert rollout.breach and rollout.breach["gate"] == (
+                "error_rate"), rollout.breach
+            for t in threads:
+                t.join(timeout=requests * (budget_s + 5))
+                assert not t.is_alive(), "load worker hung across rollback"
+            assert len(outcomes) == requests, len(outcomes)
+            bad = [r for r in outcomes if r[0] != "ok"]
+            assert not bad, (
+                f"accepted requests dropped across the bad canary: "
+                f"{bad[:3]}"
+            )
+            # Exact prior version restored on EVERY replica.
+            for h in (harness.handle(f"{spec.name}-rep{i}")
+                      for i in range(3)):
+                assert h.obj is not None and h.obj.version == 1, (
+                    h.summary()
+                )
+            members, weight = harness.router.canary()
+            assert members == frozenset(), (members, weight)
+            reg = ctl.rpc.telemetry.registry
+            assert reg.value("fleet_rollouts_total", fleet=spec.name,
+                             outcome="rolled_back") == 1
+            assert (reg.value("fleet_slo_breaches_total",
+                              fleet=spec.name, gate="error_rate") or 0) >= 1
+            # The incident bundle re-validates from disk, and its merged
+            # timeline shows the breach beside the rollback transition.
+            assert rollout.incident_path, "rollback wrote no bundle"
+            bundle = load_bundle(rollout.incident_path)
+            timeline, _meta = merge_bundles({"ctl": bundle})
+            events = [r for r in timeline if r["type"] == "event"]
+            kinds = [r["kind"] for r in events]
+            assert "fleet_slo_breach" in kinds, kinds
+            rolled = [i for i, r in enumerate(events)
+                      if r["kind"] == "fleet_rollout"
+                      and r["fields"].get("state") == "rolled_back"]
+            assert rolled, kinds
+            assert kinds.index("fleet_slo_breach") <= rolled[0], (
+                "breach does not precede the rollback on the timeline"
+            )
+            # No injections: the poison rides a params publish, so the
+            # replayable injected-event log is deterministically empty.
+            assert not plan.events, plan.events
+            plan.verify_telemetry()
+            return plan.summary()
+        finally:
+            net.detach_all()
+            harness.close()
+
+
+def scenario_fleet_role_crashloop(seed: int, *, requests: int = 120,
+                                  concurrency: int = 4,
+                                  budget_s: float = 8.0) -> Dict[str, int]:
+    """Crash-loop one replica past its restart budget: every death
+    inside the budget is respawned under jittered backoff
+    (``fleet_restart``), the death past ``restart_limit`` degrades it to
+    permanently down (``fleet_down``), routers forget the corpse and
+    traffic continues on the survivors with zero dropped requests. The
+    injected log is exactly ``restart_limit + 1`` scripted conn kills."""
+    import dataclasses
+
+    from ..fleet import FleetSpec, SupervisionSpec
+
+    spec = dataclasses.replace(
+        FleetSpec.small(replicas=3, routers=1),
+        supervision=SupervisionSpec(
+            probe_interval_s=0.1, probe_timeout_s=0.5, probe_misses=2,
+            restart_limit=2, restart_window_s=60.0,
+            backoff_base_s=0.02, backoff_cap_s=0.2,
+        ),
+    )
+    harness = FleetHarness(spec, standby=False, seed=seed)
+    plan = FaultPlan(seed)
+    net = ChaosNet(plan, harness.all_rpcs())
+    lock = threading.Lock()
+    victim = f"{spec.name}-rep0"
+    kills = spec.supervision.restart_limit + 1
+    try:
+        harness.wait_routable(3)
+        h = harness.handle(victim)
+        for k in range(kills):
+            want_spawns = k + 1
+            _await(lambda: h.status == "up" and h.spawns == want_spawns
+                   and h.rpc is not None, 15.0,
+                   f"victim never reached spawn {want_spawns}",
+                   lock=harness.cohort.lock)
+            rpc = h.rpc
+            net.attach(rpc)
+            net.kill_conns(rpc)
+            rpc.close()
+            _await(lambda: h.status != "up" or h.spawns > want_spawns,
+                   15.0, f"death {k + 1} was never detected",
+                   lock=harness.cohort.lock)
+        _await(lambda: h.status == "down", 15.0,
+               "victim was never degraded to permanently down",
+               lock=harness.cohort.lock)
+        # Routers route around the corpse.
+        _await(lambda: victim not in harness.router.routable(), 10.0,
+               "router still routes to the permanently-down replica")
+        outcomes: list = []
+        for t in _run_load(harness.router, requests, concurrency,
+                           budget_s, outcomes, lock):
+            t.join(timeout=requests * (budget_s + 5))
+            assert not t.is_alive(), "load worker hung after crash-loop"
+        bad = [r for r in outcomes if r[0] != "ok"]
+        assert not bad, (
+            f"requests dropped after the fleet routed around the "
+            f"corpse: {bad[:3]}"
+        )
+        reg = harness.controller.rpc.telemetry.registry
+        assert reg.value("fleet_restarts_total", fleet=spec.name) == (
+            spec.supervision.restart_limit)
+        assert reg.value("fleet_role_down_total", fleet=spec.name) == 1
+        # Replay determinism: exactly the scripted kills, nothing else.
+        assert [e.kind for e in plan.events] == ["conn_kill"] * kills, (
+            f"unexpected injected-event log: {plan.events}"
+        )
+        plan.verify_telemetry()
+        return plan.summary()
+    finally:
+        net.detach_all()
+        harness.close()
+
+
 SCENARIOS = {
     "drop_storm": scenario_drop_storm,
     "partition_heal": scenario_partition_heal,
@@ -2020,4 +2369,7 @@ SCENARIOS = {
     "envpool_worker_kill": scenario_envpool_worker_kill,
     "envpool_wedge": scenario_envpool_wedge,
     "envpool_poison": scenario_envpool_poison,
+    "fleet_controller_kill": scenario_fleet_controller_kill,
+    "fleet_bad_canary": scenario_fleet_bad_canary,
+    "fleet_role_crashloop": scenario_fleet_role_crashloop,
 }

@@ -1,0 +1,395 @@
+"""Fleet tier suite (ISSUE 19): declarative cohort spec, controller
+supervision + fenced adoption, and the SLO-gated canary rollout.
+
+The invariants pinned here are the docs/fleet.md contracts:
+
+- bad specs are rejected with the offending FIELD named (a typo'd knob
+  must never silently become the default), and the JSON round trip is
+  exact;
+- materialize/adopt are fenced by the cohort epoch CAS — a second
+  materialize refuses, a double adopt is a no-op, a zombie controller
+  stops itself;
+- a rollout under closed-loop load is zero-downtime: no accepted
+  request is dropped and served p99 stays within 3x the pre-roll p99
+  (floored at the transport's failure-detection tick);
+- rollback restores the EXACT prior version on every replica — from the
+  in-memory registry and from the statestore (the durable
+  ``publish_from_statestore`` path);
+- the three fleet chaos scenarios are seed-replay deterministic (their
+  injected-event logs are pinned exactly).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from moolib_tpu.fleet import (AdoptError, Controller, FleetSpec,
+                              RolloutError, SpecError)
+from moolib_tpu.testing.scenarios import (FleetHarness, _await,
+                                          _fleet_model, _p99, _run_load)
+
+
+# -- spec ---------------------------------------------------------------------
+
+
+def test_spec_rejects_bad_fields_by_name():
+    """Every rejection names the offending field path."""
+    import dataclasses
+
+    from moolib_tpu.fleet import (LearnerSpec, RolloutSpec, ServingSpec,
+                                  SupervisionSpec)
+
+    base = FleetSpec.small()
+    cases = [
+        ("learners.min_quorum",
+         dict(learners=LearnerSpec(n=2, min_quorum=5))),
+        ("serving.replicas",
+         dict(serving=ServingSpec(replicas=0, routers=1))),
+        ("serving.batch_size",
+         dict(serving=ServingSpec(replicas=1, batch_size=0))),
+        ("supervision.probe_misses",
+         dict(supervision=SupervisionSpec(probe_misses=0))),
+        ("supervision.backoff_cap_s",
+         dict(supervision=SupervisionSpec(backoff_base_s=1.0,
+                                          backoff_cap_s=0.1))),
+        ("rollout.canary_weight",
+         dict(rollout=RolloutSpec(canary_weight=1.5))),
+        ("rollout.error_rate_max",
+         dict(rollout=RolloutSpec(error_rate_max=2.0))),
+    ]
+    for field, patch in cases:
+        with pytest.raises(SpecError) as ei:
+            dataclasses.replace(base, **patch)
+        assert field in str(ei.value), (field, str(ei.value))
+
+
+def test_spec_unknown_field_rejected_with_suggestion():
+    """A typo'd knob is rejected by name, with a did-you-mean."""
+    text = FleetSpec.small().to_json().replace(
+        '"canary_weight"', '"cannary_weight"')
+    with pytest.raises(SpecError) as ei:
+        FleetSpec.from_json(text)
+    msg = str(ei.value)
+    assert "cannary_weight" in msg and "canary_weight" in msg, msg
+
+
+def test_example_configs_launch_from_fleet_spec():
+    """One validated spec drives both the controller and the training
+    examples: the learner cohort's quorum/straggler/group knobs and the
+    env tier's worker count flow into A2CConfig/VtraceConfig."""
+    import dataclasses
+
+    from moolib_tpu.examples.a2c import A2CConfig
+    from moolib_tpu.examples.vtrace.experiment import VtraceConfig
+    from moolib_tpu.fleet import LearnerSpec
+
+    spec = dataclasses.replace(
+        FleetSpec.small(learners=3, env_workers=4),
+        learners=LearnerSpec(n=3, min_quorum=2,
+                             straggler_timeout_s=1.5, group="g1"),
+    )
+    a2c = A2CConfig.from_fleet_spec(spec, total_steps=100)
+    assert (a2c.num_processes, a2c.min_quorum, a2c.straggler_timeout,
+            a2c.group, a2c.total_steps) == (4, 2, 1.5, "g1", 100)
+    vt = VtraceConfig.from_fleet_spec(spec)
+    assert (vt.num_actor_processes, vt.min_quorum,
+            vt.straggler_timeout, vt.group) == (4, 2, 1.5, "g1")
+
+
+def test_spec_json_round_trip_identity():
+    spec = FleetSpec.small(replicas=3, routers=1, learners=2,
+                           env_workers=4, settle_s=2.5)
+    again = FleetSpec.from_json(spec.to_json())
+    assert again == spec
+    assert again.to_json() == spec.to_json()
+    assert spec.n_roles() == 1 + 2 + 4 + 3 + 1  # broker+learn+env+rep+rt
+
+
+# -- fencing ------------------------------------------------------------------
+
+
+def test_second_materialize_refused_and_double_adopt_noop():
+    spec = FleetSpec.small(replicas=2, routers=1, learners=0,
+                           env_workers=0)
+    primary = Controller(spec, name="ctl0")
+    primary.materialize()
+    standby = Controller(spec, cohort=primary.cohort, name="ctl1",
+                         standby=True, failover_after_s=3600.0)
+    try:
+        # A second materialize against a held cohort must refuse — it
+        # would double-spawn every role.
+        rival = Controller(spec, name="rival", cohort=primary.cohort)
+        with pytest.raises(AdoptError):
+            rival.materialize()
+        rival.close()
+        # Kill the primary; adopt explicitly (the watcher is disabled
+        # via the huge failover window, so the test drives the fence).
+        primary.kill()
+        first = standby.adopt()
+        assert first["already"] is False and first["epoch"] == 2, first
+        # Double adopt: a fenced no-op — it can never double-spawn.
+        again = standby.adopt()
+        assert again == {"already": True, "epoch": 2}, again
+        assert standby.status()["fenced"]
+        # The dead primary is fenced out: its next fenced action raises.
+        with pytest.raises(AdoptError):
+            primary.start_rollout(version=1)
+    finally:
+        standby.close()
+        primary.close(close_roles=True)
+
+
+def test_fleet_harness_scales_past_thirty_peers():
+    """The capacity substrate (ROADMAP items 1-4): a 30-role cohort —
+    brokers, learners, env workers, replicas, routers — materializes
+    in-process on one host and every role answers supervision."""
+    import dataclasses
+
+    from moolib_tpu.fleet import BrokerSpec
+
+    spec = dataclasses.replace(
+        FleetSpec.small(replicas=5, routers=1, learners=10,
+                        env_workers=12),
+        broker=BrokerSpec(standbys=1),
+    )
+    assert spec.n_roles() == 30
+    harness = FleetHarness(spec, standby=True)
+    try:
+        harness.wait_routable(5)
+        status = harness.controller.status()
+        assert len(status["roles"]) == 30
+        assert all(r["status"] == "up" for r in status["roles"].values())
+        # Supervision holds at this scale: a probe sweep leaves every
+        # role up (misses would flip status within a few intervals).
+        time.sleep(spec.supervision.probe_interval_s * 4)
+        status = harness.controller.status()
+        assert all(r["status"] == "up" for r in status["roles"].values())
+        out = harness.router.infer(np.ones(4, np.float32))
+        assert float(out[0]) == 2.0
+    finally:
+        harness.close()
+
+
+def test_subprocess_backend_spawns_real_processes():
+    """The production shape: broker + replica as real subprocesses
+    (``python -m moolib_tpu.fleet.runner``), the router in-process (it
+    is the rollout's dispatch surface), probes over the wire."""
+    spec = FleetSpec.small(replicas=1, routers=1, learners=0,
+                           env_workers=0)
+    ctl = Controller(spec, backend="subprocess")
+    try:
+        ctl.materialize()
+        st = ctl.status()["roles"]
+        assert st[f"{spec.name}-broker0"]["backend"] == "subprocess"
+        assert st[f"{spec.name}-rep0"]["backend"] == "subprocess"
+        assert st[f"{spec.name}-router0"]["backend"] == "in_process"
+        with ctl.cohort.lock:
+            procs = [h.proc for h in ctl.cohort.roles.values()
+                     if h.backend == "subprocess"]
+        assert len(procs) == 2
+        assert all(p is not None and p.poll() is None for p in procs)
+        _await(lambda: len(ctl.router().routable()) >= 1, 15.0,
+               "subprocess replica never became routable")
+        out = ctl.router().infer(np.ones(4, np.float32))
+        assert float(out[0]) == 2.0
+    finally:
+        ctl.close(close_roles=True)
+
+
+# -- rollout ------------------------------------------------------------------
+
+
+def test_zero_downtime_rollout_under_load():
+    """ISSUE 19 acceptance: rolling a new model version through a
+    3-replica/1-router fleet under closed-loop load drops zero accepted
+    requests and holds served p99 within 3x the pre-roll p99 (floored
+    at the transport's 100ms failure-detection tick)."""
+    spec = FleetSpec.small(replicas=3, routers=1, settle_s=1.5)
+    harness = FleetHarness(spec, standby=False, model=_fleet_model,
+                           params={"scale": np.float32(2.0)})
+    lock = threading.Lock()
+    try:
+        harness.wait_routable(3)
+        ctl = harness.controller
+        # Pre-roll baseline under the same concurrency.
+        pre: list = []
+        for t in _run_load(harness.router, 120, 4, 8.0, pre, lock):
+            t.join(timeout=60)
+            assert not t.is_alive(), "pre-roll load worker hung"
+        assert all(k == "ok" for k, _l, _v in pre), pre[:3]
+        p99_pre = _p99([lat for _k, lat, _v in pre])
+
+        ctl.publish_model({"scale": np.float32(3.0)}, 2)
+        rollout = ctl.start_rollout(version=2, wait=False)
+        _await(lambda: rollout.state == "settling", 10.0,
+               "rollout never reached settling")
+        during: list = []
+        threads = _run_load(harness.router, 240, 4, 8.0, during, lock)
+        _await(lambda: rollout.state in ("promoted", "rolled_back"),
+               spec.rollout.settle_s + 15.0,
+               "rollout never reached a terminal state")
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "mid-roll load worker hung"
+        assert rollout.state == "promoted", rollout.state
+        bad = [r for r in during if r[0] != "ok"]
+        assert not bad, f"requests dropped across the rollout: {bad[:3]}"
+        p99_roll = _p99([lat for _k, lat, _v in during])
+        bound = 3.0 * max(p99_pre, 0.1)
+        assert p99_roll <= bound, (
+            f"p99 blew out across the rollout: pre={p99_pre:.4f}s "
+            f"during={p99_roll:.4f}s (bound {bound:.4f}s)"
+        )
+        # Every replica ends on the new version; canary slice cleared.
+        for i in range(3):
+            h = harness.handle(f"{spec.name}-rep{i}")
+            assert h.obj.version == 2, h.summary()
+        assert harness.router.canary() == (frozenset(), 0.0)
+        assert harness.controller.status()["current_version"] == 2
+    finally:
+        harness.close()
+
+
+def test_rollback_restores_exact_prior_version_from_statestore(tmp_path):
+    """The durable rollback path: with ``store=`` the prior params come
+    back out of the statestore (not memory), so rollback survives the
+    trainer host; every replica ends on the exact prior version, and
+    ``publish_from_statestore`` republishes the same durable version."""
+    from moolib_tpu.serving import publish_from_statestore
+    from moolib_tpu.statestore import StateStore
+
+    spec = FleetSpec.small(replicas=3, routers=1, settle_s=3.0)
+    v1 = {"scale": np.float32(2.0)}
+    harness = FleetHarness(spec, standby=False, model=_fleet_model,
+                           params=v1, incident_dir=str(tmp_path / "inc"))
+    # Attach the store to the controller's Rpc so its counters land in
+    # that per-Rpc registry — a bare store would increment the
+    # process-global statestore_* counters test_statestore.py asserts
+    # absolute values on.
+    store = StateStore(str(tmp_path / "store"), harness.controller.rpc)
+    lock = threading.Lock()
+    try:
+        harness.wait_routable(3)
+        store.put(1, v1)
+        ctl = harness.controller
+        rollout = ctl.start_rollout(
+            params={"scale": np.float32(9.0), "poison": True},
+            version=2, wait=False, store=store,
+        )
+        _await(lambda: rollout.state == "settling", 10.0,
+               "rollout never reached settling")
+        outcomes: list = []
+        threads = _run_load(harness.router, 160, 4, 8.0, outcomes, lock)
+        _await(lambda: rollout.state in ("promoted", "rolled_back"),
+               spec.rollout.settle_s + 15.0,
+               "rollout never reached a terminal state")
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "load worker hung across rollback"
+        assert rollout.state == "rolled_back", rollout.state
+        assert not [r for r in outcomes if r[0] != "ok"], outcomes[:3]
+        for i in range(3):
+            h = harness.handle(f"{spec.name}-rep{i}")
+            assert h.obj.version == 1, h.summary()
+            out = harness.router.infer(np.ones(4, np.float32))
+            assert float(out[0]) == 2.0  # prior params, exactly
+        # The durable publish surface agrees with the rollback.
+        v, acks = publish_from_statestore(harness.router, store,
+                                          version=1)
+        assert v == 1 and all(acks.values()), (v, acks)
+    finally:
+        store.close()
+        harness.close()
+
+
+def test_rollout_refuses_to_canary_whole_fleet():
+    """canary_replicas == routable fleet is refused up front: a breach
+    would leave no stable slice to retreat to."""
+    spec = FleetSpec.small(replicas=1, routers=1, learners=0,
+                           env_workers=0)
+    harness = FleetHarness(spec, standby=False)
+    try:
+        harness.wait_routable(1)
+        harness.controller.publish_model({"scale": np.float32(3.0)}, 2)
+        with pytest.raises(RolloutError):
+            harness.controller.start_rollout(version=2)
+    finally:
+        harness.close()
+
+
+# -- router canary dispatch ---------------------------------------------------
+
+
+def test_router_canary_validation_and_weighting():
+    spec = FleetSpec.small(replicas=2, routers=1, learners=0,
+                           env_workers=0)
+    harness = FleetHarness(spec, standby=False)
+    try:
+        harness.wait_routable(2)
+        router = harness.router
+        rep0 = f"{spec.name}-rep0"
+        with pytest.raises(ValueError):
+            router.set_canary(["nope"], 0.5)
+        with pytest.raises(ValueError):
+            router.set_canary([rep0], 1.5)
+        with pytest.raises(ValueError):
+            router.set_canary([], 0.5)
+        # weight=1.0: every healthy pick prefers the canary slice.
+        router.set_canary([rep0], 1.0)
+        x = np.ones(4, np.float32)
+        for _ in range(20):
+            router.infer(x)
+        s = router.slice_stats()
+        assert s["canary"]["n"] == 20 and s["stable"]["n"] == 0, s
+        # A fractional weight splits traffic across both slices.
+        router.set_canary([rep0], 0.5)
+        for _ in range(60):
+            router.infer(x)
+        s = router.slice_stats()
+        assert s["canary"]["n"] > 0 and s["stable"]["n"] > 0, s
+        assert s["canary"]["n"] + s["stable"]["n"] == 60, s
+        router.clear_canary()
+        assert router.canary() == (frozenset(), 0.0)
+        # forget_replica drops the name from the slice too.
+        router.set_canary([rep0], 0.5)
+        router.forget_replica(rep0)
+        assert router.canary() == (frozenset(), 0.0)
+    finally:
+        harness.close()
+
+
+# -- chaos scenarios (seed-replay determinism pinned in tier-1) ---------------
+
+
+def test_fleet_controller_kill_scenario():
+    """SIGKILL the primary mid-rollout: the standby adopts behind the
+    epoch fence, the in-flight canary resumes and completes, no
+    accepted request is dropped across the handoff — and the injected
+    log is exactly the scripted kill, every run of this seed."""
+    from moolib_tpu.testing.scenarios import scenario_fleet_controller_kill
+
+    summary = scenario_fleet_controller_kill(seed=301)
+    assert summary == {"conn_kill": 1}, summary
+
+
+def test_fleet_bad_canary_scenario():
+    """A poisoned canary build auto-rolls-back within the settle window
+    with zero accepted requests dropped and a re-validating incident
+    bundle; the injected log is deterministically empty (the poison
+    rides a params publish, not a fault injection)."""
+    from moolib_tpu.testing.scenarios import scenario_fleet_bad_canary
+
+    summary = scenario_fleet_bad_canary(seed=302)
+    assert summary == {}, summary
+
+
+def test_fleet_role_crashloop_scenario():
+    """A replica crash-looping past its restart budget is degraded to
+    permanently down and routed around; the injected log is exactly
+    restart_limit + 1 scripted conn kills."""
+    from moolib_tpu.testing.scenarios import scenario_fleet_role_crashloop
+
+    summary = scenario_fleet_role_crashloop(seed=303)
+    assert summary == {"conn_kill": 3}, summary

@@ -45,8 +45,16 @@ def _rules_of(findings):
 # -- tier-1 enforcement -------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_package_clean_against_baseline():
-    """THE enforcement test: no new findings vs the checked-in baseline."""
+    """THE enforcement test: no new findings vs the checked-in baseline.
+
+    ~90s of whole-package lint wall on this container — the exact sweep
+    ci_check.sh's first stage (``moolint.py --check moolib_tpu/``) also
+    runs — so it is slow-marked out of the tier-1 window (ISSUE 19
+    headroom) and runs in ci_check's dedicated lint-tests stage
+    instead; coverage is unchanged, only the budget it bills against
+    moved."""
     if not BASELINE.exists():
         pytest.skip("no lint baseline checked in; run "
                     "`python tools/moolint.py --baseline-update`")
@@ -59,7 +67,13 @@ def test_package_clean_against_baseline():
     )
 
 
+@pytest.mark.slow
 def test_cli_clean_tree_exits_zero():
+    """Pin the CLI exit code on a clean tree.
+
+    Another whole-package sweep (~60s) duplicating ci_check.sh's first
+    moolint stage, so it rides in the same dedicated slow-lint stage
+    there rather than the tier-1 window (ISSUE 19 headroom)."""
     if not BASELINE.exists():
         pytest.skip("no lint baseline checked in")
     proc = subprocess.run(

@@ -91,16 +91,16 @@ def test_suite_catalogue_covers_the_cpu_proxies():
     # The ISSUE 7 catalogue plus ISSUE 8's serving rows, ISSUE 12's
     # env-tier recovery row, ISSUE 14's shm transport-lane row, ISSUE
     # 15's durable-state replication row, ISSUE 17's hotwatch-gated
-    # learner e2e row, and ISSUE 18's paritywatch gate-cost row: every
-    # named proxy present, every entry carrying a
-    # reproduce-command-compatible name.
+    # learner e2e row, ISSUE 18's paritywatch gate-cost row, and ISSUE
+    # 19's fleet rollout row: every named proxy present, every entry
+    # carrying a reproduce-command-compatible name.
     assert set(CPU_PROXY_SUITE) == {
         "rpc_echo_latency_s", "rpc_payload_gbps", "rpc_shm_payload_gbps",
         "allreduce_tree_gbps",
         "batcher_fill_s", "envpool_steps_per_s", "envpool_recovery_s",
         "serial_encode_gbps", "serial_decode_gbps",
         "statestore_replicate_gbps", "serving_qps",
-        "serving_p99_latency_s", "e2e_learner_step_s",
+        "serving_p99_latency_s", "fleet_rollout_s", "e2e_learner_step_s",
         "parity_check_s",
     }
 

@@ -3,7 +3,7 @@
 Unit-level: acquire/release pairing per tracked kind, the leak report
 naming the acquisition-site stack, and the weakref-entry exemption.
 Integration-level: the chaos scenarios run leak-free under the tracker —
-the same pass ci_check.sh runs over all 15 scenarios via
+the same pass ci_check.sh runs over all 18 scenarios via
 `chaos_soak.py --smoke --restrack`.
 """
 
@@ -138,7 +138,7 @@ def test_mark_scopes_the_window():
 def test_chaos_scenarios_restrack_clean():
     """ISSUE 16 acceptance (tier-1 slice): two chaos scenarios — one wire
     cohort, one envpool worker-kill — run under the tracker with every
-    acquisition released by the end. The full 15-scenario pass rides
+    acquisition released by the end. The full 18-scenario pass rides
     ci_check.sh as `chaos_soak.py --smoke --locktrace --restrack`."""
     from moolib_tpu.testing.scenarios import SCENARIOS
 

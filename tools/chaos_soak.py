@@ -1,5 +1,5 @@
 """chaosnet scenario runner: seeded fault-injection soak for the
-RPC/Group/Accumulator stack and the serving tier.
+RPC/Group/Accumulator stack, the serving tier, and the fleet tier.
 
 Runs the canonical chaos scenarios (``moolib_tpu.testing.scenarios`` —
 the SAME implementations the tier-1 suite pins, so CI smoke and tests
@@ -8,9 +8,12 @@ cannot drift) against a live in-process cluster. Two modes:
 - ``--smoke``: one pass over all scenarios (loss storm, partition+heal,
   leader loss, learner SIGKILL+restart, broker kill+standby promotion,
   straggler slow-link quorum commit, serving replica-kill mid-load,
-  serving router-partition, and the env tier's survivable trio:
+  serving router-partition, the env tier's survivable trio:
   env-worker SIGKILL mid-batch, SIGSTOP wedge vs the hung-step
-  watchdog, poison-env quarantine), bounded well under 60s, CPU-only —
+  watchdog, poison-env quarantine, and the fleet tier's trio:
+  controller SIGKILL mid-rollout with standby adoption, bad-canary
+  SLO-gated auto-rollback, replica crash-loop past its restart
+  budget), bounded well under 90s, CPU-only —
   the CI stage wired into tools/ci_check.sh. The serving pair is the
   ROADMAP item-3 acceptance: a router + in-process replicas on
   OS-assigned ports, one replica killed mid-load, bounded completion

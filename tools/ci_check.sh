@@ -37,6 +37,17 @@ python tools/moolint.py --baseline-stats --fail-nonempty
 python tools/moolint.py --baseline-stats --fail-nonempty \
   --baseline moolib_tpu/analysis/baseline_tools.json
 
+echo "== lint enforcement tests (slow-marked) =="
+# The two whole-package lint tests — the in-process lint_paths diff
+# against the baseline and the CLI exit-zero pin — are ~150s of pure
+# moolint wall, the same sweep the three stages above just ran. They
+# are slow-marked out of the tier-1 pytest window (ISSUE 19 headroom)
+# and run here as their own named stage, mirroring the chip_session
+# rehearsal precedent: coverage is unchanged, only the budget it
+# bills against moved.
+timeout -k 10 400 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_lint.py -q -m slow -p no:cacheprovider
+
 echo "== perf smoke =="
 # One stage, two layers (docs/perf.md):
 # 1. telemetry_smoke.py — live __telemetry scrape of a two-Rpc cohort
@@ -85,7 +96,7 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu python -m pytest \
   tests/test_parity.py -q -p no:cacheprovider
 
 echo "== chaos + serving smoke =="
-# Bounded seeded fault-injection pass (12 scenarios, well under 60s,
+# Bounded seeded fault-injection pass (18 scenarios, well under 90s,
 # CPU-only): loss storm, partition+heal, leader loss, the survivable-
 # training trio (learner SIGKILL + same-name restart rejoin with loss
 # continuity; broker kill + standby promotion adopting the epoch from
@@ -99,7 +110,12 @@ echo "== chaos + serving smoke =="
 # once retry, steps/s recovery; SIGSTOP wedge reaped by the hung-step
 # watchdog within its deadline; poison env quarantined while the
 # cohort keeps stepping — process-level ProcFaultPlan faults with the
-# same seed-replay discipline as the wire faults). A failure prints
+# same seed-replay discipline as the wire faults), plus the fleet
+# tier's trio (controller SIGKILL mid-rollout: standby adopts behind
+# the epoch fence and the canary completes; bad canary: SLO-gated
+# auto-rollback within the settle window with an incident bundle;
+# replica crash-loop past its restart budget: permanent-down +
+# route-around). A failure prints
 # the seed + replay command (long-run version: chaos_soak.py
 # --minutes; --scenario GLOB selects a subset; per-scenario wall time
 # rides the JSON report).
@@ -115,7 +131,7 @@ echo "== chaos + serving smoke =="
 # --restrack runs it under the resource tracker too (testing/restrack.py,
 # lifelint's dynamic mirror): every thread/SharedMemory/Rpc/gauge
 # acquisition a scenario makes must be released by its end, so the
-# 15-scenario pass doubles as a leak soak — a leak fails the scenario
+# 18-scenario pass doubles as a leak soak — a leak fails the scenario
 # with the acquisition-site stack.
 env JAX_PLATFORMS=cpu python tools/chaos_soak.py --smoke --locktrace --restrack
 
@@ -138,6 +154,21 @@ echo "== statestore restore smoke =="
 # stage pins the plain-path restore in isolation so a wire-family or
 # negotiation regression is named here, in seconds.
 timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/statestore_smoke.py
+
+echo "== fleet smoke =="
+# The fleet tier end to end (docs/fleet.md): a FleetSpec.small cohort
+# (broker, learner, env worker, 3 replicas, router) materializes from
+# its JSON-round-tripped spec, a healthy version promotes through the
+# canary state machine under closed-loop load (zero dropped requests),
+# a poisoned version auto-rolls-back on the error-rate SLO gate with
+# the exact promoted version restored on every replica and a
+# re-validating incident bundle — with the fleet_* counters and
+# fleet_* flightrec events checked as evidence. The chaos pass above
+# already runs the three fleet scenarios (controller SIGKILL
+# mid-rollout, bad canary, replica crash-loop) under locktrace +
+# restrack; this stage pins the plain promote/rollback path in
+# isolation so a rollout regression is named here, in seconds.
+timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/fleet_smoke.py
 
 echo "== incident smoke =="
 # flightrec end-to-end (docs/incidents.md): an in-process cohort under a

@@ -91,6 +91,14 @@ MODULES = [
      "dynamic batching in jit, hot model swap"),
     ("moolib_tpu.serving.router", "load-aware dispatch, deadline "
      "propagation, replica failover and retry safety"),
+    ("moolib_tpu.fleet.spec", "declarative cohort shape: validated, "
+     "JSON-round-trippable FleetSpec tree"),
+    ("moolib_tpu.fleet.controller", "fleet controller: materialization, "
+     "restart-budget supervision, epoch-fenced standby adoption"),
+    ("moolib_tpu.fleet.rollout", "canary rollout state machine with "
+     "SLO-gated auto-promote/auto-rollback"),
+    ("moolib_tpu.fleet.runner", "subprocess role entrypoint "
+     "(python -m moolib_tpu.fleet.runner)"),
     ("moolib_tpu.parallel.accumulator", "elastic data-parallel gradient "
      "accumulation (ICI psum + DCN tree)"),
     ("moolib_tpu.parallel.mesh", "device mesh construction and batch "
@@ -221,7 +229,10 @@ def _index() -> str:
         "protocol, CPU-proxy suite, perf budgets, and the "
         "trend/regression gate: [perf.md](perf.md). Serving-tier "
         "architecture, failure model, deadline/shedding semantics, and "
-        "retry-safety rules: [serving.md](serving.md).",
+        "retry-safety rules: [serving.md](serving.md). Fleet tier — "
+        "declarative cohort specs, supervised roles, epoch-fenced "
+        "controller failover, and SLO-gated canary rollouts: "
+        "[fleet.md](fleet.md).",
         "",
         "Other entry points:",
         "",
