@@ -141,6 +141,26 @@ def _result(name: str, value, unit, direction, smoke, stats=None,
     )
 
 
+def _compact_summary(s):
+    """Round one stepscope loop summary down to a row-sized attachment."""
+    return {
+        "steps": s["steps"],
+        "wall_s": round(s["wall_s"], 6),
+        "phases": {k: round(v, 6) for k, v in s["phases"].items()},
+        "fractions": {k: round(v, 6) for k, v in s["fractions"].items()},
+    }
+
+
+def _stepscope_extra(snapshot, loop):
+    """Compact phase-ledger attachment for a row's ``extra``: the named
+    loop's per-phase seconds and derived fractions reconstructed from a
+    registry snapshot (None when the loop never recorded a step)."""
+    from ..telemetry import summarize_stepscope
+
+    s = summarize_stepscope(snapshot).get(loop)
+    return None if s is None else _compact_summary(s)
+
+
 # -- RPC echo + payload -------------------------------------------------------
 
 
@@ -477,6 +497,11 @@ def bench_envpool_steps(smoke: bool) -> BenchResult:
         batches = 2 * n + 2
         dt = batches * bs / value
         snap = global_telemetry().snapshot()
+        # The pools' built-in StepScopes already attributed every batch
+        # (env_wait / staging / batch_fill) into the global registry;
+        # pin the composition snapshot to the row so the perf ledger
+        # shows WHERE the batch time went, not just the rate.
+        stepscope = _stepscope_extra(snap, "envpool")
         return _result(
             "envpool_steps_per_s", value, "env-steps/s",
             "higher", smoke,
@@ -485,7 +510,8 @@ def bench_envpool_steps(smoke: bool) -> BenchResult:
             extra={"batch_size": bs, "procs": 1,
                    "supervision_overhead_frac": round(overhead, 4),
                    "supervised_best": sup_best,
-                   "unsupervised_best": raw_best},
+                   "unsupervised_best": raw_best,
+                   "stepscope": stepscope},
         )
     finally:
         pool.close()
@@ -865,14 +891,21 @@ def bench_e2e_learner_step(smoke: bool) -> BenchResult:
     from ..models import A2CNet
     from ..testing.hotwatch import Hotwatch
 
+    from ..telemetry import StepScope, Telemetry
+
     t_dim, b_dim, f_dim, a_dim = (4, 4, 5, 3) if smoke else (8, 16, 5, 3)
     steps = 10 if smoke else 50
     net = A2CNet(num_actions=a_dim, hidden_sizes=(32,))
     params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 1, f_dim)),
                       jnp.zeros((1, 1), bool), ())
     state = make_train_state(params, optax.sgd(1e-3))
+    # Private telemetry: the bench's phase ledger must not accumulate
+    # into the process-global registry other rows snapshot.
+    scope = StepScope("bench_learner_step",
+                      telemetry=Telemetry("perfwatch-stepscope"))
     step = make_impala_train_step(
-        net.apply, optax.sgd(1e-3), ImpalaConfig(), donate=True
+        net.apply, optax.sgd(1e-3), ImpalaConfig(), donate=True,
+        stepscope=scope,
     )
     key = jax.random.PRNGKey(1)
     ks = jax.random.split(key, 4)
@@ -900,17 +933,21 @@ def bench_e2e_learner_step(smoke: bool) -> BenchResult:
         nonlocal state
         with hw:
             for _ in range(steps):
-                state, metrics = step(state, batch)
+                with scope.step():
+                    state, metrics = step(state, batch)
         jax.block_until_ready(state)
 
     samples = [s / steps for s in measure(
         run_window, warmup=1, repeats=3 if smoke else 5
     )]
     stats = trimmed_stats(samples)
+    stepscope = _compact_summary(scope.summary())
+    scope.close()
     return _result(
         "e2e_learner_step_s", stats["median"], "s", "lower", smoke,
         stats=stats,
         extra={
+            "stepscope": stepscope,
             # The acceptance numbers: zero steady-state synchronous D2H,
             # compile counts flat across the window. A violation raises
             # out of run_window, so reaching here proves them — recorded

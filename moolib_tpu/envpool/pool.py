@@ -614,10 +614,14 @@ class EnvStepperFuture:
                 return value
             raise value
         pool = self._pool
+        # One gate check, then stamp the blocked wait for the phase
+        # ledger: time spent HERE is the caller's env_wait.
+        t_wait = time.monotonic() if pool._tel.on else 0.0
         if pool._ctrl is not None and not self._has_callback:
             pool._wait_native(self._batch_index, timeout)
         elif not self._event.wait(timeout):
             raise TimeoutError("EnvStepperFuture.result timed out")
+        wait_s = (time.monotonic() - t_wait) if t_wait else 0.0
         if self._outcome is not None:
             # Resolved while we waited (supervisor failed the batch).
             kind, value = self._outcome
@@ -625,7 +629,7 @@ class EnvStepperFuture:
                 return value
             raise value
         try:
-            out = pool._collect(self._batch_index)
+            out = pool._collect(self._batch_index, wait_s)
         except Exception as e:
             self._outcome = ("error", e)
             raise
@@ -893,6 +897,16 @@ class EnvPool:
         reg = self._tel.registry
         self._m_steps = reg.counter("envpool_steps_total")
         self._m_step_dur = reg.histogram("envpool_step_seconds")
+        # Step-phase attribution (docs/observability.md): each collected
+        # batch is one "step" of the envpool loop, its wall time split
+        # into env_wait (caller blocked in result()), staging (the H2D
+        # device_put in _collect), and batch_fill (the remainder — the
+        # workers filling the slab while the caller was elsewhere).
+        # observe_step is the overlap-safe path: double-buffered batches
+        # overlap in wall time, so each carries its own stamps.
+        from ..telemetry.stepscope import StepScope
+
+        self._scope = StepScope("envpool", telemetry=self._tel)
         self._m_deaths: Dict[str, Any] = {}
         self._m_respawns = reg.counter("envpool_respawns_total", pool=name)
         self._m_respawn_fail = reg.counter(
@@ -1691,7 +1705,7 @@ class EnvPool:
             self._callbacks.clear()
         self._run_callbacks(pending)
 
-    def _collect(self, batch_index: int):
+    def _collect(self, batch_index: int, wait_s: float = 0.0):
         with self._lock:
             err = self._batch_error[batch_index]
         if err is not None:
@@ -1712,15 +1726,29 @@ class EnvPool:
             self._busy[batch_index] = False
         if t0:
             self._m_step_dur.observe(time.monotonic() - t0)
+        stage_s = 0.0
         if self.device is not None:
             import jax
 
             # One batched H2D transfer; copies, so the shm views are free to
             # be overwritten by the next step of this buffer immediately.
-            return jax.device_put(out, self.device)
-        # Zero-copy: numpy views over the shared segment. Valid until this
-        # buffer's next step() (same contract as the reference's from_blob
-        # tensors, src/env.cc:387-401).
+            t_stage = time.monotonic() if t0 else 0.0
+            out = jax.device_put(out, self.device)
+            if t_stage:
+                stage_s = time.monotonic() - t_stage
+        # else: zero-copy numpy views over the shared segment. Valid until
+        # this buffer's next step() (same contract as the reference's
+        # from_blob tensors, src/env.cc:387-401).
+        if t0:
+            # Telemetry OUTSIDE pool._lock (the registry-lock/GC cycle
+            # note above); per-batch stamps make this overlap-safe.
+            wall = time.monotonic() - t0
+            wait_s = min(wait_s, wall)
+            self._scope.observe_step(wall, {
+                "env_wait": wait_s,
+                "staging": stage_s,
+                "batch_fill": max(wall - wait_s - stage_s, 0.0),
+            })
         return out
 
     # -- lifecycle -----------------------------------------------------------
@@ -1747,6 +1775,7 @@ class EnvPool:
         # the closed pool and raise instead of hanging forever. Registered
         # callbacks fire now for the same reason.
         self._fail_all_waiters()
+        self._scope.close()
         if self._ctrl is not None:
             # Wake the notify loop so it observes _closed and exits.
             if self._notify_thread is not None:

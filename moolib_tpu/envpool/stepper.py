@@ -46,6 +46,7 @@ from typing import Optional
 import numpy as np
 
 from ..rpc import RpcError
+from ..telemetry.stepscope import StepScope
 from ..utils import get_logger
 from .pool import _check_wait_timeout
 
@@ -112,6 +113,12 @@ class EnvPoolServer:
         self._m_step_errors = reg.counter(
             "envpool_served_step_errors_total", pool=name
         )
+        # Step-phase attribution (docs/observability.md): every served
+        # step is one batch_fill-shaped step of the serving loop — the
+        # server never blocks a thread on it (deferred reply), so the
+        # whole dispatch->completion span is fill time, stamped from the
+        # completion callback via the overlap-safe observe_step path.
+        self._scope = StepScope(f"{name}_served", telemetry=rpc.telemetry)
         # Weakref: the registry outlives this server; a strong `self`
         # would pin the pool's shared-memory slabs after close(), which
         # also unregisters these series.
@@ -247,7 +254,9 @@ class EnvPoolServer:
         # handler provided comes from the deferred reply instead).
         def on_done(f):
             if tel_on:
-                self._m_step_dur.observe(time.monotonic() - t0)
+                dur = time.monotonic() - t0
+                self._m_step_dur.observe(dur)
+                self._scope.observe_step(dur, {"batch_fill": dur})
             try:
                 deferred(f.result(timeout=0))
             except (asyncio.CancelledError,
@@ -268,6 +277,7 @@ class EnvPoolServer:
         if self._closed:  # the close() idempotence contract
             return
         self._closed = True
+        self._scope.close()
         reg = self.rpc.telemetry.registry
         for gname in ("envpool_buffers_free", "envpool_clients"):
             reg.unregister(gname, pool=self.name)

@@ -21,12 +21,13 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
 
 import moolib_tpu
-from moolib_tpu.telemetry import publish_metrics
+from moolib_tpu.telemetry import StepScope, publish_metrics
 from moolib_tpu.examples.common import (
     EnvBatchState,
     InProcessBroker,
@@ -68,6 +69,12 @@ class A2CConfig:
     # a standby broker address+name enables member-driven failover.
     min_quorum: Optional[int] = None
     straggler_timeout: Optional[float] = None
+    # When False, the step blocks on the gradient reduction result right
+    # after contributing — comms deliberately serialized onto the
+    # critical path. The default pipelines the reduction under the next
+    # rollout; stepscope's exposed_comms_fraction is exactly the gauge
+    # that tells these two modes apart (docs/observability.md).
+    overlap_comms: bool = True
     broker: Optional[str] = None  # None -> start an in-process broker
     broker_standby: Optional[str] = None  # standby broker address
     broker_standby_name: str = "broker2"
@@ -300,21 +307,27 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
     env_steps = 0
     next_log = cfg.log_interval_steps
     futures = [pool.step(i, actions[i]) for i in range(cfg.num_batches)]
+    # Phase attribution for the learner loop (docs/observability.md,
+    # "Step-phase attribution"): one ledger per while-iteration, phases
+    # env_wait / host_sync / fwd_bwd / grad_allreduce / optimizer.
+    scope = StepScope("a2c_learner")
 
     try:
         while env_steps < cfg.total_steps:
+          with scope.step():
             for i in range(cfg.num_batches):
                 # Bounded wait: a dead env worker must surface as an
                 # error, not hang the training loop forever. WorkerDied is
                 # the RETRY-SAFE class (pool supervision respawns the
                 # worker; same-action retry is exactly-once per env), so
                 # training survives an actor-process death mid-run.
-                try:
-                    out = futures[i].result(timeout=300.0)
-                except moolib_tpu.WorkerDied:
-                    out = moolib_tpu.step_with_retry(
-                        pool, i, actions[i], timeout=300.0
-                    )
+                with scope.phase("env_wait"):
+                    try:
+                        out = futures[i].result(timeout=300.0)
+                    except moolib_tpu.WorkerDied:
+                        out = moolib_tpu.step_with_retry(
+                            pool, i, actions[i], timeout=300.0
+                        )
                 bs = batch_states[i]
                 unroll = bs.observe(out)
                 if unroll is not None:
@@ -332,8 +345,9 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
                     jnp.asarray(out["done"]),
                     bs.core_state,
                 )
-                a = np.asarray(a)  # hotlint: sync -- actions must reach the host NOW to feed the envpool slab: the Sebulba actor-loop boundary, not a stray sync
-                bs.record_action(a, np.asarray(logits), core)  # hotlint: sync -- behavior logits ride the host-side unroll buffer with the action that produced them
+                with scope.phase("host_sync"):
+                    a = np.asarray(a)  # hotlint: sync -- actions must reach the host NOW to feed the envpool slab: the Sebulba actor-loop boundary, not a stray sync
+                    bs.record_action(a, np.asarray(logits), core)  # hotlint: sync -- behavior logits ride the host-side unroll buffer with the action that produced them
                 actions[i][:] = a
                 futures[i] = pool.step(i, actions[i])
                 env_steps += cfg.batch_size
@@ -348,12 +362,13 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
                             k: jnp.asarray(v) if not isinstance(v, tuple) else v
                             for k, v in unroll.items()
                         }
-                        grads, metrics = grad_step(state.params, batch)
-                        # Defer the host readback (same as the vtrace loop):
-                        # a float() here would block on device execution
-                        # before reduce_gradients could even stage the
-                        # async D2H.
-                        pending_metrics.append(stage_host_async(metrics))
+                        with scope.phase("fwd_bwd"):
+                            grads, metrics = grad_step(state.params, batch)
+                            # Defer the host readback (same as the vtrace
+                            # loop): a float() here would block on device
+                            # execution before reduce_gradients could even
+                            # stage the async D2H.
+                            pending_metrics.append(stage_host_async(metrics))
                         if len(pending_metrics) >= 64:
                             # Bound the backlog; all but the newest have had
                             # >=1 update of transfer time.
@@ -361,23 +376,42 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
                         # grad_scale already turned batch-mean grads into
                         # the batch-sum contribution inside the jit
                         # (Accumulator contract: src/accumulator.cc:880-1003).
-                        accumulator.reduce_gradients(
-                            grads, batch_size=cfg.batch_size
-                        )
+                        with scope.phase("grad_allreduce"):
+                            accumulator.reduce_gradients(
+                                grads, batch_size=cfg.batch_size
+                            )
+                            if not cfg.overlap_comms:
+                                # Deliberately serialized: block this step
+                                # on the reduction result so the wire wait
+                                # is exposed on the critical path — the
+                                # measurable baseline the overlap work
+                                # (ROADMAP item 4) must beat.
+                                deadline = time.monotonic() + 60.0
+                                while (
+                                    accumulator.connected()
+                                    and not accumulator.has_gradients()
+                                    and time.monotonic() < deadline
+                                ):
+                                    accumulator.update()
+                                    time.sleep(0.0005)
                     else:
                         accumulator.skip_gradients()
                         stats["skips"] += 1
                 if accumulator.has_gradients():
-                    mean_grads, _count = accumulator.result_gradients()
-                    # Atomic with the rebind: a get_state on an RPC thread
-                    # between the donating dispatch and the rebind would
-                    # device_get buffers the donation just invalidated.
-                    with state_lock:
-                        state = apply_step(
-                            state,
-                            jax.tree_util.tree_map(jnp.asarray, mean_grads),
-                        )
-                    accumulator.zero_gradients()
+                    with scope.phase("optimizer"):
+                        mean_grads, _count = accumulator.result_gradients()
+                        # Atomic with the rebind: a get_state on an RPC
+                        # thread between the donating dispatch and the
+                        # rebind would device_get buffers the donation
+                        # just invalidated.
+                        with state_lock:
+                            state = apply_step(
+                                state,
+                                jax.tree_util.tree_map(
+                                    jnp.asarray, mean_grads
+                                ),
+                            )
+                        accumulator.zero_gradients()
                     stats["updates"] += 1
 
             for bs in batch_states:
@@ -402,6 +436,7 @@ def train(cfg: A2CConfig, log_fn=print) -> List[dict]:
                 stats["total_loss"].reset()
                 stats["entropy"].reset()
     finally:
+        scope.close()
         pool.close()
         accumulator.close()
         rpc.close()

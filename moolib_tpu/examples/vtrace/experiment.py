@@ -36,7 +36,7 @@ from typing import List, Optional
 import numpy as np
 
 import moolib_tpu
-from moolib_tpu.telemetry import publish_metrics
+from moolib_tpu.telemetry import StepScope, publish_metrics
 from moolib_tpu.examples.common import EnvBatchState, StatMean, StatSum, Stats
 from moolib_tpu.examples import common
 from moolib_tpu.examples.common.record import TsvLogger, write_metadata
@@ -249,7 +249,13 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
         entropy_cost=cfg.entropy_cost,
         reward_clip=cfg.reward_clip,
     )
-    act = make_act_step(net.apply)
+    # Phase attribution for this loop (docs/observability.md, "Step-
+    # phase attribution"): the jitted steps are scoped through the learner
+    # factories (act / fwd_bwd / optimizer), the wait-shaped phases
+    # (env_wait / host_sync / grad_allreduce / checkpoint) are explicit
+    # below.
+    scope = StepScope("vtrace_learner")
+    act = make_act_step(net.apply, stepscope=scope)
     learn_apply = net.apply
     if getattr(net, "mlp", "dense") == "moe":
         # MoE models sow per-layer aux (lb/z losses, drop fraction) into
@@ -270,6 +276,7 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
     grad_step = make_grad_step(
         learn_apply, config=loss_cfg, mesh=mesh,
         grad_scale=float(cfg.learn_batch_size),
+        stepscope=scope,
     )
     # apply_step donates its state argument: the previous generation's
     # buffers die the moment the update is dispatched, so XLA updates in
@@ -279,7 +286,8 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
     # must be mutually exclusive — state_lock below. Lock order is always
     # accumulator._lock -> state_lock; nothing under state_lock takes
     # the accumulator's lock back.
-    apply_step = make_apply_step(optimizer, donate=True)
+    apply_step = make_apply_step(optimizer, donate=True,
+                                 stepscope=scope)
     state_lock = threading.Lock()
 
     # --- elasticity / persistence ------------------------------------------
@@ -433,6 +441,7 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
             cfg.max_seconds is None
             or time.monotonic() - t_start < cfg.max_seconds
         ):
+          with scope.step():
             # -- acting (double-buffered) -----------------------------------
             for i in range(cfg.num_actor_batches):
                 # Bounded wait: a dead env worker must surface as an
@@ -440,12 +449,13 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                 # the RETRY-SAFE class (pool supervision respawns the
                 # worker; same-action retry is exactly-once per env), so
                 # training survives an actor-process death mid-run.
-                try:
-                    out = futures[i].result(timeout=300.0)
-                except moolib_tpu.WorkerDied:
-                    out = moolib_tpu.step_with_retry(
-                        pool, i, actions[i], timeout=300.0
-                    )
+                with scope.phase("env_wait"):
+                    try:
+                        out = futures[i].result(timeout=300.0)
+                    except moolib_tpu.WorkerDied:
+                        out = moolib_tpu.step_with_retry(
+                            pool, i, actions[i], timeout=300.0
+                        )
                 bs = batch_states[i]
                 unroll = bs.observe(out)
                 if unroll is not None:
@@ -470,8 +480,9 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                     jnp.asarray(out["done"]),
                     bs.core_state,
                 )
-                a = np.asarray(a)  # hotlint: sync -- actions must reach the host NOW to feed the envpool slab: the Sebulba actor-loop boundary, not a stray sync
-                bs.record_action(a, np.asarray(logits), core)  # hotlint: sync -- behavior logits ride the host-side unroll buffer with the action that produced them
+                with scope.phase("host_sync"):
+                    a = np.asarray(a)  # hotlint: sync -- actions must reach the host NOW to feed the envpool slab: the Sebulba actor-loop boundary, not a stray sync
+                    bs.record_action(a, np.asarray(logits), core)  # hotlint: sync -- behavior logits ride the host-side unroll buffer with the action that produced them
                 actions[i][:] = a
                 futures[i] = pool.step(i, actions[i])
                 env_steps += cfg.actor_batch_size
@@ -507,9 +518,10 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                             # Bound the backlog; everything but the newest
                             # entry has had >=1 update of transfer time.
                             drain_metrics(keep_last=1)
-                        accumulator.reduce_gradients(
-                            grads, batch_size=cfg.learn_batch_size
-                        )
+                        with scope.phase("grad_allreduce"):
+                            accumulator.reduce_gradients(
+                                grads, batch_size=cfg.learn_batch_size
+                            )
                     else:
                         accumulator.skip_gradients()
                         stats["skips"] += 1
@@ -539,13 +551,14 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                 last_stats_enqueue = now
                 gsa.enqueue_global_stats()
             if ckpt is not None and accumulator.is_leader():
-                ckpt.maybe_save(
-                    lambda: {
-                        "state": jax.device_get(state),
-                        "model_version": applied_version,
-                        "config": dataclasses.asdict(cfg),
-                    }
-                )
+                with scope.phase("checkpoint"):
+                    ckpt.maybe_save(
+                        lambda: {
+                            "state": jax.device_get(state),
+                            "model_version": applied_version,
+                            "config": dataclasses.asdict(cfg),
+                        }
+                    )
             if env_steps >= next_log:
                 next_log += cfg.log_interval_steps
                 drain_metrics()
@@ -579,6 +592,7 @@ def train(cfg: VtraceConfig, log_fn=print) -> List[dict]:
                 )
                 window.reset()
     finally:
+        scope.close()
         profiler.close()
         pool.close()
         learn_batcher.close()

@@ -66,6 +66,12 @@ KINDS: Dict[str, Tuple[str, ...]] = {
     "fleet_adopt": ("fleet", "controller", "epoch", "roles"),
     "fleet_rollout": ("fleet", "state", "version"),
     "fleet_slo_breach": ("fleet", "gate", "value", "bound"),
+    # Step-phase attribution (moolib_tpu/telemetry/stepscope.py): a
+    # periodic stamp of a hot loop's windowed critical-path fractions,
+    # so the merged incident timeline shows what the cohort was spending
+    # its steps on when it died.
+    "step_phases": ("loop", "steps", "wall_s", "exposed_comms",
+                    "host_blocked", "env_wait"),
     # chaosnet injections (moolib_tpu/testing/chaos.py) and the incident
     # machinery itself (moolib_tpu/flightrec/capture.py)
     "chaos": ("kind", "action", "peer", "endpoint"),

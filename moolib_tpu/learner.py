@@ -78,6 +78,26 @@ def make_train_state(params, optimizer: optax.GradientTransformation) -> TrainSt
     )
 
 
+def _scoped(fn: Callable, stepscope, phase: str) -> Callable:
+    """Wrap a jitted step so each invocation is attributed to a stepscope
+    phase (moolib_tpu.telemetry.stepscope). The phase CM no-ops outside
+    an active ``scope.step()``, so a scoped step factory is safe to call
+    from anywhere; with dispatch being async, the attributed time is
+    trace/compile on the first call and dispatch overhead after — the
+    blocking readback shows up in the caller's ``host_sync`` phase, where
+    it actually serializes."""
+    if stepscope is None:
+        return fn
+    cm = stepscope.phase(phase)
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with cm:
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
 def _entropy(logits):
     """Mean policy entropy (positive), [.., A] logits."""
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -180,6 +200,7 @@ def make_impala_train_step(
     donate: bool = True,
     loss_fn: Callable = impala_loss,
     batch_axes: Optional[dict] = None,
+    stepscope=None,
 ) -> Callable[[TrainState, dict], Tuple[TrainState, dict]]:
     """Build the jitted train step ``(state, batch) -> (state, metrics)``.
 
@@ -213,7 +234,10 @@ def make_impala_train_step(
             )(state.params, batch)
             return sgd(state, grads, metrics)
 
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
+        return _scoped(
+            jax.jit(step, donate_argnums=(0,) if donate else ()),
+            stepscope, "fwd_bwd",
+        )
 
     replicated = P()
 
@@ -238,7 +262,10 @@ def make_impala_train_step(
             out_specs=(replicated, replicated),
         )(state, batch)
 
-    return jax.jit(sharded_step, donate_argnums=(0,) if donate else ())
+    return _scoped(
+        jax.jit(sharded_step, donate_argnums=(0,) if donate else ()),
+        stepscope, "fwd_bwd",
+    )
 
 
 def make_grad_step(
@@ -249,6 +276,7 @@ def make_grad_step(
     loss_fn: Callable = impala_loss,
     batch_axes: Optional[dict] = None,
     grad_scale: Optional[float] = None,
+    stepscope=None,
 ) -> Callable[[Any, dict], Tuple[Any, dict]]:
     """Build the jitted gradient step ``(params, batch) -> (grads, metrics)``.
 
@@ -289,7 +317,7 @@ def make_grad_step(
             )(params, batch)
             return finish(grads, metrics)
 
-        return jax.jit(step)
+        return _scoped(jax.jit(step), stepscope, "fwd_bwd")
 
     replicated = P()
 
@@ -311,11 +339,12 @@ def make_grad_step(
             out_specs=(replicated, replicated),
         )(params, batch)
 
-    return jax.jit(sharded_step)
+    return _scoped(jax.jit(sharded_step), stepscope, "fwd_bwd")
 
 
 def make_apply_step(
-    optimizer: optax.GradientTransformation, donate: bool = True
+    optimizer: optax.GradientTransformation, donate: bool = True,
+    stepscope=None,
 ) -> Callable[[TrainState, Any], TrainState]:
     """Build the jitted optimizer-apply step ``(state, grads) -> state`` for
     externally-reduced gradients (the other half of :func:`make_grad_step`)."""
@@ -327,10 +356,14 @@ def make_apply_step(
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1)
 
-    return jax.jit(apply, donate_argnums=(0,) if donate else ())
+    return _scoped(
+        jax.jit(apply, donate_argnums=(0,) if donate else ()),
+        stepscope, "optimizer",
+    )
 
 
-def make_act_step(apply_fn: Callable, temperature: float = 1.0):
+def make_act_step(apply_fn: Callable, temperature: float = 1.0,
+                  stepscope=None):
     """Jitted acting step for the actor loop / EnvPool double-buffering.
 
     ``(params, rng, obs_B, done_B, core_state) ->
@@ -357,7 +390,7 @@ def make_act_step(apply_fn: Callable, temperature: float = 1.0):
         a = jax.random.categorical(rng, logits, axis=-1)
         return a, logits, core_state
 
-    return act
+    return _scoped(act, stepscope, "act")
 
 
 def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:

@@ -77,6 +77,7 @@ import numpy as np
 # and returns; the numpy conversion happens on an RPC completion thread
 # once the count round resolves (the TPU equivalent of the reference's
 # async pinned-memory copies, src/accumulator.cc:941-980).
+from ..telemetry.stepscope import StepScope
 from ..utils import get_logger, nest, stage_host_async as _stage_host_async
 from ..rpc.group import Group
 from ..rpc.rpc import Rpc, RpcError
@@ -419,6 +420,15 @@ class Accumulator:
         self._m_writeoffs = reg.counter("acc_straggler_writeoffs_total")
         self._m_recontributed = reg.counter("acc_recontributed_total")
         self._m_participation = reg.histogram("acc_round_participation")
+        # Step-phase attribution for gradient rounds (docs/observability
+        # .md): each completed round is one "step" whose ledger splits
+        # round lifetime into local_reduce (host-side materialization of
+        # staged contribution parts, timed in reduce_gradients) and
+        # wire_wait (everything else: the tree reduction itself). The
+        # per-round local-reduce accumulator is guarded by _lock like the
+        # parts list it times.
+        self._scope = StepScope("acc_grad_round", telemetry=rpc.telemetry)
+        self._scope_local_s = 0.0
         # The registry outlives this Accumulator; a strong `self` in the
         # gauge closures would pin model-sized buffers (_zeros_bundle,
         # _committed_bundle, _results) after close(). A dead ref scrapes
@@ -575,9 +585,11 @@ class Accumulator:
                 ):
                     done_parts.append(self._pending_parts.pop(0))
                 if done_parts:
+                    t0 = time.monotonic()
                     self._pending_parts.insert(
                         0, _materialize_parts(done_parts)
                     )
+                    self._scope_local_s += time.monotonic() - t0
             self._pending_parts.append(tree)
             self._pending_bs += int(batch_size)
             self._pending_ngrads += 1
@@ -1325,7 +1337,19 @@ class Accumulator:
                             self._synced = False
                         log.debug("gradient round failed: %s", e)
                 return
-            self._m_grad_round_dur.observe(time.monotonic() - round_t0)
+            round_dt = time.monotonic() - round_t0
+            self._m_grad_round_dur.observe(round_dt)
+            with self._lock:
+                local_s = self._scope_local_s
+                self._scope_local_s = 0.0
+            # Outside _lock (telemetry-outside-locks discipline); the
+            # round's wire_wait is its lifetime minus this peer's own
+            # local-reduce work in the window.
+            self._scope.observe_step(
+                round_dt,
+                {"local_reduce": min(local_s, round_dt),
+                 "wire_wait": max(round_dt - local_s, 0.0)},
+            )
             with self._lock:
                 if self._epoch != epoch:
                     return
@@ -1475,6 +1499,7 @@ class Accumulator:
         reg = self.rpc.telemetry.registry
         for name in self._gauge_names:
             reg.unregister(name)
+        self._scope.close()
         for name in self._endpoint_names:
             self.rpc.undefine(name)
         if self._owns_group:

@@ -42,6 +42,7 @@ import numpy as np
 from ..ops.batcher import stage_batch
 from ..rpc import Rpc, RpcError
 from ..telemetry import FRACTION_EDGES
+from ..telemetry.stepscope import StepScope
 from ..utils import get_logger, nest
 from .admission import AdmissionQueue, DeadlineExceeded, Overloaded
 
@@ -124,6 +125,13 @@ class Replica:
                                      edges=FRACTION_EDGES, service=service)
         self._m_version = reg.gauge("serving_model_version", service=service)
         self._m_version.set(float(self._version))
+        # Step-phase attribution (docs/observability.md): each served
+        # batch is one step of the serve loop — queue_wait (blocked in
+        # get_batch before the first entry), linger (the deliberate
+        # coalescing window), infer (stack/stage/model/replies). Idle
+        # ticks that pop nothing record no step, so the fractions
+        # describe served traffic, not a quiet replica.
+        self._scope = StepScope(f"{service}_replica", telemetry=tel)
         # Weakref inflight gauge (the shared-registry lifetime contract).
         # Peer-labelled so two same-service replicas sharing one
         # Telemetry never replace or cross-unregister each other's
@@ -205,6 +213,7 @@ class Replica:
         """One bounded serve tick (pop + batch); driven by
         :func:`_serve_entry` so the worker never holds ``self`` across a
         wait."""
+        t_tick = time.monotonic()
         try:
             serve, shed = self.admission.get_batch(
                 self.batch_size, timeout=0.1, linger=self.linger_s
@@ -215,6 +224,7 @@ class Replica:
         except Exception as e:
             log.error("serve loop pop failed: %s", e)
             return
+        pop_s = time.monotonic() - t_tick
         if shed:
             for dr, _x in shed:
                 self._reply_error(
@@ -225,9 +235,24 @@ class Replica:
             self.admission.fail(len(shed), shed=True)
         if not serve:
             return
-        self._run_batch(serve)
+        infer_s = self._run_batch(serve)
+        if infer_s is not None and self._tel.on:
+            # get_batch blocks for the first entry, then lingers up to
+            # linger_s to coalesce — the split below attributes at most
+            # the configured linger to the coalescing window and the
+            # rest of the pop to queue_wait (the exact boundary is
+            # internal to the admission queue's condvar).
+            wall = time.monotonic() - t_tick
+            linger = min(pop_s, self.linger_s) if self.linger_s > 0 else 0.0
+            self._scope.observe_step(wall, {
+                "queue_wait": max(pop_s - linger, 0.0),
+                "linger": linger,
+                "infer": infer_s,
+            })
 
-    def _run_batch(self, serve):
+    def _run_batch(self, serve) -> Optional[float]:
+        """Serve one admitted batch; returns the batch service time in
+        seconds, or None when the batch failed (callers got errors)."""
         n = len(serve)
         t0 = time.monotonic()
         with self._model_lock:
@@ -264,7 +289,7 @@ class Replica:
             for dr, _x in serve:
                 self._reply_error(dr, f"{type(e).__name__}: {e}")
             self.admission.fail(n)
-            return
+            return None
         dt = time.monotonic() - t0
         for (dr, _x), r in zip(serve, results):
             self._reply(dr, r)
@@ -273,6 +298,7 @@ class Replica:
             self._m_batches.inc()
             self._m_rows.inc(n)
             self._m_fill.observe(n / self.batch_size)
+        return dt
 
     @staticmethod
     def _reply(dr, value):
@@ -306,6 +332,7 @@ class Replica:
             self.rpc.undefine(f"{self.service}.{suffix}")
         self.admission.close()
         self._worker.join(timeout=5)
+        self._scope.close()
         reg = self.rpc.telemetry.registry
         reg.unregister("serving_inflight", service=self.service,
                        peer=self.rpc.get_name())

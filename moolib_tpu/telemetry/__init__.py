@@ -51,10 +51,18 @@ from .trace import Span, TraceBuffer, now_us, spans_to_chrome
 # moolib_tpu.telemetry.trace, which is satisfied mid-cycle only because
 # those submodules are already in sys.modules by this line.
 from ..flightrec.recorder import FlightRecorder
+from .stepscope import (
+    PHASE_CLASS,
+    StepScope,
+    summarize_metrics as summarize_stepscope,
+)
 
 __all__ = [
     "Telemetry",
     "FlightRecorder",
+    "StepScope",
+    "PHASE_CLASS",
+    "summarize_stepscope",
     "Registry",
     "Counter",
     "Gauge",

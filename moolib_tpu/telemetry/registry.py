@@ -31,10 +31,11 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import math
+import os
 import re
 import threading
 from bisect import bisect_left, insort
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "Counter",
@@ -44,9 +45,22 @@ __all__ = [
     "DEFAULT_TIME_EDGES",
     "FRACTION_EDGES",
     "EXPORT_QUANTILES",
+    "DEFAULT_LABEL_CARDINALITY",
+    "OVERFLOW_LABEL",
     "parse_prometheus",
     "quantile_from_export",
 ]
+
+#: Label value a series family's overflow folds into once the family has
+#: seen :data:`DEFAULT_LABEL_CARDINALITY` distinct values (env override
+#: ``MOOLIB_TPU_LABEL_CARDINALITY``). Wire-controlled strings (peer
+#: names, endpoint names, stepscope phase labels) reach the registry as
+#: label values; without a cap one misbehaving/malicious peer could mint
+#: an unbounded number of series and explode every scrape.
+OVERFLOW_LABEL = "other"
+
+#: Default cap on distinct values per (metric name, label key) family.
+DEFAULT_LABEL_CARDINALITY = 64
 
 #: Default histogram edges: powers of two covering 1µs .. 64s — the
 #: latency range of everything from an inline dispatch to a timed-out
@@ -356,27 +370,78 @@ class Registry:
     Series identity is ``(name, sorted(labels))``; asking for an existing
     series returns the existing object (so concurrent components share
     counters safely), asking with a conflicting metric type raises.
+
+    Label cardinality is capped per (metric name, label key) family at
+    ``label_cardinality`` distinct values (default
+    :data:`DEFAULT_LABEL_CARDINALITY`, env
+    ``MOOLIB_TPU_LABEL_CARDINALITY``): the value that would exceed the
+    cap is folded into the :data:`OVERFLOW_LABEL` series and
+    ``telemetry_label_overflow_total`` counts every folded lookup — a
+    wire-controlled peer/endpoint/phase name can cost at most one extra
+    series per family, never an unbounded scrape.
     """
 
-    def __init__(self):
+    def __init__(self, label_cardinality: Optional[int] = None):
         self._lock = threading.Lock()
         self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Any] = {}
         self._sorted_keys: List[Tuple[str, Tuple[Tuple[str, str], ...]]] = []
+        if label_cardinality is None:
+            label_cardinality = int(os.environ.get(
+                "MOOLIB_TPU_LABEL_CARDINALITY", DEFAULT_LABEL_CARDINALITY
+            ))
+        self._label_cap = max(1, int(label_cardinality))
+        # (metric name, label key) -> distinct values admitted so far.
+        # Monotone: unregister() does NOT return capacity — a family that
+        # churned through the cap once keeps folding, so a recreate loop
+        # cannot defeat the guard.
+        self._label_values: Dict[Tuple[str, str], Set[str]] = {}
 
     # -- creation -------------------------------------------------------------
 
-    @staticmethod
-    def _key(name: str, labels: Dict[str, Any]):
+    def _key(self, name: str, labels: Dict[str, Any], admit: bool = False):
+        """Canonical ``(name, sorted-label-items)`` key with the
+        cardinality guard applied: once a (name, label-key) family holds
+        ``label_cardinality`` distinct values, any unseen value folds to
+        :data:`OVERFLOW_LABEL` and ``telemetry_label_overflow_total``
+        counts the fold. ``admit`` marks creation-path lookups — only
+        those may claim one of the family's value slots (reads and
+        unregisters observe, never consume, capacity)."""
         if not _NAME_RE.match(name):
             raise ValueError(f"bad metric name {name!r}")
         items = tuple(sorted((k, str(v)) for k, v in labels.items()))
-        for k, _v in items:
+        folded: Optional[List[Tuple[str, str]]] = None
+        overflowed = False
+        for i, (k, v) in enumerate(items):
             if not _LABEL_RE.match(k):
                 raise ValueError(f"bad label name {k!r}")
+            if v == OVERFLOW_LABEL:
+                continue
+            fam = (name, k)
+            seen = self._label_values.get(fam)
+            if seen is not None and v in seen:
+                continue
+            with self._lock:
+                seen = self._label_values.setdefault(fam, set())
+                if v in seen:
+                    continue
+                if len(seen) < self._label_cap:
+                    if admit:
+                        seen.add(v)
+                    continue
+            if folded is None:
+                folded = list(items)
+            folded[i] = (k, OVERFLOW_LABEL)
+            overflowed = True
+        if folded is not None:
+            items = tuple(folded)
+        if overflowed and name != "telemetry_label_overflow_total":
+            self._get_or_create(
+                "telemetry_label_overflow_total", {}, Counter, Counter
+            ).inc()
         return name, items
 
     def _get_or_create(self, name, labels, factory, cls):
-        key = self._key(name, labels)
+        key = self._key(name, labels, admit=True)
         m = self._metrics.get(key)
         if m is None:
             with self._lock:
@@ -417,7 +482,7 @@ class Registry:
         semantics matter: a component recreated under the same identity
         (a Group re-registered on the same Rpc) must not leave a stale
         closure reading its dead predecessor."""
-        key = self._key(name, labels)
+        key = self._key(name, labels, admit=True)
         with self._lock:
             existing = self._metrics.get(key)
             if isinstance(existing, _GaugeFn):

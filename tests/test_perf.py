@@ -185,6 +185,108 @@ def test_detector_honors_row_declared_tolerance():
         BenchResult(metric="m", value=1.0, unit="x", tol=1.5)
 
 
+# -- stepscope fraction rows (ISSUE 20) ---------------------------------------
+#
+# The critical-path fractions ride the SAME store and detector as the
+# throughput rows: unit "fraction", direction "lower" (a growing
+# exposed-comms share is a step-composition regression even when
+# headline throughput holds), loop-qualified metric names so an
+# envpool's env-wait series never shares a baseline with a learner's.
+
+STEPSCOPE_SMOKE_CMD = "python tools/stepscope_report.py --smoke"
+
+
+def _fraction_summary(exposed, loop="a2c_learner"):
+    return {
+        "loop": loop, "steps": 50, "wall_s": 1.0,
+        "phases": {"grad_allreduce": exposed, "other": 1.0 - exposed},
+        "fractions": {"exposed_comms": exposed, "host_blocked": 0.0,
+                      "env_wait": 0.0},
+    }
+
+
+def _fraction_rows(exposed_values, loop="a2c_learner"):
+    from moolib_tpu.telemetry.stepscope import trend_rows
+
+    rows = []
+    for v in exposed_values:
+        rows.extend(trend_rows(_fraction_summary(v, loop), smoke=True,
+                               cmd=STEPSCOPE_SMOKE_CMD))
+    return rows
+
+
+def test_stepscope_trend_rows_are_schema_valid_fraction_rows(tmp_path):
+    from moolib_tpu.telemetry.stepscope import (STEPSCOPE_TREND_TOLERANCE,
+                                                trend_rows)
+
+    rows = trend_rows(_fraction_summary(0.2), smoke=True,
+                      cmd=STEPSCOPE_SMOKE_CMD)
+    assert [r.metric for r in rows] == [
+        "stepscope_a2c_learner_exposed_comms_fraction",
+        "stepscope_a2c_learner_host_blocked_fraction",
+        "stepscope_a2c_learner_env_wait_fraction",
+    ]
+    store = tmp_path / "trends.jsonl"
+    for r in rows:
+        # Every row rides the unified schema: unit "fraction", the bad
+        # direction is UP so the schema direction is "lower", the wide
+        # smoke-scale tolerance is declared per row, and the round-trip
+        # through the store is exact.
+        assert r.unit == "fraction"
+        assert r.direction == "lower"
+        assert r.suite == "stepscope"
+        assert r.tol == STEPSCOPE_TREND_TOLERANCE
+        assert 0.0 <= r.value <= 1.0
+        assert r.extra == {"loop": "a2c_learner", "steps": 50}
+        assert parse_result(r.to_json()) == r
+        append_trend(store, r)
+    assert [r.metric for r in load_trends(store)] == [r.metric for r in rows]
+
+
+def test_stepscope_direction_vocabulary_is_lower_not_down():
+    # The phase fractions trend "down is good"; the schema's vocabulary
+    # for that is direction="lower" — "down" itself must be rejected at
+    # construction, not silently stored and skipped by the detector.
+    with pytest.raises(ValueError, match="direction"):
+        BenchResult(metric="stepscope_x_exposed_comms_fraction", value=0.1,
+                    unit="fraction", direction="down")
+
+
+def test_detector_flags_planted_exposed_comms_regression():
+    """An exposed-comms share stepping 0.04 -> 0.5 (overlap silently
+    disabled) must flag despite the wide tol=0.5 band, with the smoke's
+    reproduce command on the verdict."""
+    rng = random.Random(20)
+    history = [0.04 * (1 + rng.gauss(0, 0.05)) for _ in range(8)]
+    regs = detect_regressions(_fraction_rows(history + [0.5]))
+    assert len(regs) == 1
+    r = regs[0]
+    assert r.metric == "stepscope_a2c_learner_exposed_comms_fraction"
+    assert r.current == pytest.approx(0.5)
+    assert r.cmd == STEPSCOPE_SMOKE_CMD
+    assert "rose" in r.message() and "reproduce:" in r.message()
+
+
+def test_detector_fraction_tolerance_and_direction_semantics():
+    rng = random.Random(21)
+    history = [0.04 * (1 + rng.gauss(0, 0.05)) for _ in range(8)]
+    # tol=0.5 semantics: a +40% drift stays inside the declared band
+    # (fractions are noisy at smoke scale) ...
+    assert detect_regressions(_fraction_rows(history + [0.055])) == []
+    # ... and an IMPROVEMENT (comms fully overlapped) never flags.
+    assert detect_regressions(_fraction_rows(history + [0.0])) == []
+
+
+def test_fraction_rows_per_loop_series_never_share_a_baseline():
+    """A learner sitting at 0.05 exposed comms and an accumulator whose
+    wire-wait share is legitimately ~0.9 coexist in one store: the
+    loop-qualified metric names keep their baselines apart, so neither
+    flags the other."""
+    rows = _fraction_rows([0.05, 0.05, 0.05, 0.05, 0.05], "a2c_learner")
+    rows += _fraction_rows([0.9, 0.9, 0.9, 0.9, 0.9], "acc_grad_round")
+    assert detect_regressions(rows) == []
+
+
 # -- budgets ------------------------------------------------------------------
 
 

@@ -1,0 +1,419 @@
+"""stepscope: step-phase attribution + critical-path fractions (ISSUE 20).
+
+Unit layer drives the context managers on a fake monotonic clock so the
+self-time ledger arithmetic is pinned exactly (nesting, residual
+``other``, overrun, windowed gauges). The acceptance layer runs the real
+seeded A2C cohort (in-process broker + accumulator peer + EnvPool
+workers) and asserts the ISSUE 20 criteria: ledgers sum to wall within
+5%, the three derived fractions appear in a live ``__telemetry`` scrape
+AND a flightrec bundle AND schema-valid trend rows, and a deliberately
+serialized (``overlap_comms=False``) run shows strictly higher
+exposed-comms than the overlapped baseline.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+
+import pytest
+
+from moolib_tpu.telemetry import (
+    StepScope,
+    Telemetry,
+    summarize_stepscope,
+)
+from moolib_tpu.telemetry.stepscope import (
+    FRACTION_GAUGES,
+    PHASE_CLASS,
+    merge_summaries,
+    phase_trace,
+    trend_rows,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    clk = FakeClock()
+    monkeypatch.setattr(time, "monotonic", clk)
+    return clk
+
+
+def _scope(**kw):
+    return StepScope(kw.pop("loop", "loop"),
+                     telemetry=kw.pop("telemetry", None) or Telemetry("t"),
+                     **kw)
+
+
+# -- ledger arithmetic --------------------------------------------------------
+
+
+def test_nested_phases_self_time_and_other_residual(clock):
+    scope = _scope()
+    with scope.step():
+        with scope.phase("grad_allreduce"):
+            clock.advance(0.3)
+            with scope.phase("host_sync"):
+                clock.advance(0.5)
+            clock.advance(0.2)
+        clock.advance(1.0)  # unattributed -> "other"
+    s = scope.summary()
+    # Self-time: the nested host_sync's 0.5s is attributed to host_sync
+    # ONLY; the enclosing comms phase keeps its own 0.5s.
+    assert s["phases"] == pytest.approx(
+        {"grad_allreduce": 0.5, "host_sync": 0.5, "other": 1.0})
+    assert s["wall_s"] == pytest.approx(2.0)
+    assert s["fractions"]["exposed_comms"] == pytest.approx(0.25)
+    assert s["fractions"]["host_blocked"] == pytest.approx(0.25)
+    assert s["fractions"]["env_wait"] == 0.0
+    # Ledger closes exactly: explicit + other == wall.
+    assert sum(s["phases"].values()) == pytest.approx(s["wall_s"])
+
+
+def test_repeated_phase_accumulates_and_gauges_track_window(clock):
+    scope = _scope(window=2)
+    reg = scope._tel.registry
+    for comms in (0.8, 0.2, 0.4):
+        with scope.step():
+            with scope.phase("wire_wait"):
+                clock.advance(comms)
+            with scope.phase("wire_wait"):
+                clock.advance(0.0)
+            clock.advance(1.0 - comms)
+    # Windowed gauge: only the LAST 2 steps (0.2 + 0.4 over 2.0s walls).
+    g = reg.snapshot()[f'{FRACTION_GAUGES["comms"]}{{loop="loop"}}']
+    assert g["value"] == pytest.approx(0.3)
+    # Cumulative counters carry the lifetime total.
+    assert scope.summary()["phases"]["wire_wait"] == pytest.approx(1.4)
+    assert scope.summary()["fractions"]["exposed_comms"] == pytest.approx(
+        1.4 / 3.0)
+
+
+def test_note_overrun_surfaces_as_gauge_not_corrupt_fractions(clock):
+    scope = _scope()
+    with scope.step():
+        clock.advance(1.0)
+        # Externally timed addition that overlaps the same wall second:
+        # explicit 1.5s > wall 1.0s. The overrun is surfaced, never
+        # silently rescaled into the fractions.
+        scope.note("host_sync", 1.5)
+    snap = scope._tel.snapshot()
+    assert snap['stepscope_ledger_overrun_fraction{loop="loop"}'][
+        "value"] == pytest.approx(0.5)
+    assert snap['stepscope_attributed_fraction{loop="loop"}'][
+        "value"] == pytest.approx(1.0)
+    s = scope.summary()
+    assert "other" not in s["phases"]
+    assert s["fractions"]["host_blocked"] == pytest.approx(1.5)
+
+
+def test_observe_step_threadsafe_aggregation(clock):
+    scope = _scope()
+    n, per = 8, 50
+
+    def worker():
+        for _ in range(per):
+            scope.observe_step(0.01, {"env_wait": 0.004, "staging": 0.002})
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = scope.summary()
+    assert s["steps"] == n * per
+    assert s["wall_s"] == pytest.approx(n * per * 0.01)
+    assert s["phases"]["env_wait"] == pytest.approx(n * per * 0.004)
+    assert s["fractions"]["env_wait"] == pytest.approx(0.4)
+    assert s["fractions"]["host_blocked"] == pytest.approx(0.2)
+
+
+def test_gate_off_records_nothing_and_mid_step_flip_is_safe(clock):
+    tel = Telemetry("t", enabled=False)
+    scope = _scope(telemetry=tel)
+    with scope.step():
+        with scope.phase("env_wait"):
+            clock.advance(1.0)
+    scope.observe_step(1.0, {"env_wait": 1.0})
+    assert scope.summary()["steps"] == 0
+    # Gate snapshot at step entry: enabling mid-step must not produce a
+    # torn ledger (the step stays off); the NEXT step records.
+    with scope.step():
+        tel.set_enabled(True)
+        with scope.phase("env_wait"):
+            clock.advance(1.0)
+    assert scope.summary()["steps"] == 0
+    with scope.step():
+        with scope.phase("env_wait"):
+            clock.advance(1.0)
+    assert scope.summary()["steps"] == 1
+    # ... and disabling mid-step closes the in-flight step cleanly.
+    with scope.step():
+        tel.set_enabled(False)
+        with scope.phase("env_wait"):
+            clock.advance(1.0)
+    assert scope.summary()["steps"] == 2
+
+
+def test_close_unregisters_gauges_keeps_cumulative_series(clock):
+    scope = _scope()
+    with scope.step():
+        with scope.phase("env_wait"):
+            clock.advance(0.5)
+    scope.close()
+    snap = scope._tel.snapshot()
+    assert not any("fraction{" in sid and "phase_fraction" not in sid
+                   for sid in snap), sorted(snap)
+    # Counters survive their producer, like every other registry series.
+    assert snap['stepscope_steps_total{loop="loop"}']["value"] == 1
+    assert 'stepscope_phase_seconds_total{loop="loop",phase="env_wait"}' \
+        in snap
+
+
+def test_flight_events_and_trace_spans(clock):
+    tel = Telemetry("t", tracing=True)
+    scope = _scope(telemetry=tel, flight_every=2)
+    for i in range(4):
+        scope.observe_step(1.0, {"grad_allreduce": 0.25}, ts_us=1000 * i)
+    events = [e for e in tel.flight.events() if e["kind"] == "step_phases"]
+    assert [e["fields"]["steps"] for e in events] == [2, 4]
+    assert events[-1]["fields"]["loop"] == "loop"
+    assert events[-1]["fields"]["exposed_comms"] == pytest.approx(0.25)
+    assert events[-1]["fields"]["wall_s"] == pytest.approx(4.0)
+    trace = tel.chrome_trace()
+    names = {e["name"] for e in trace["traceEvents"]
+             if e.get("cat") == "stepscope"}
+    assert names == {"phase grad_allreduce", "phase other"}
+
+
+# -- snapshot analysis --------------------------------------------------------
+
+
+def test_summarize_metrics_matches_live_summary(clock):
+    tel = Telemetry("t")
+    scope = _scope(telemetry=tel)
+    for _ in range(3):
+        scope.observe_step(2.0, {"wire_wait": 0.5, "host_sync": 0.25,
+                                 "queue_wait": 0.25})
+    live = scope.summary()
+    recon = summarize_stepscope(tel.snapshot())["loop"]
+    window = recon.pop("window")
+    assert recon == live
+    assert window["comms"] == pytest.approx(0.25)
+    assert window["attributed"] == pytest.approx(0.5)
+    assert window["ledger_overrun"] == 0.0
+    # After close() the gauges are gone; the cumulative reconstruction
+    # still works (the dead-peer bundle story).
+    scope.close()
+    assert summarize_stepscope(tel.snapshot())["loop"] == live
+
+
+def test_merge_summaries_dedups_shared_global_registry(clock):
+    tel = Telemetry("t")
+    scope = _scope(telemetry=tel)
+    scope.observe_step(1.0, {"env_wait": 0.5})
+    one = summarize_stepscope(tel.snapshot())
+    # Two peers in one OS process scrape the same global registry: the
+    # cohort merge must count the shared loop once, not twice.
+    merged = merge_summaries({"peer-a": one, "peer-b": one})
+    assert merged["loop"]["steps"] == 1
+    assert merged["loop"]["fractions"]["env_wait"] == pytest.approx(0.5)
+    # Genuinely distinct summaries sum.
+    scope.observe_step(1.0, {"env_wait": 0.5})
+    two = summarize_stepscope(tel.snapshot())
+    merged = merge_summaries({"peer-a": one, "peer-b": two})
+    assert merged["loop"]["steps"] == 3
+
+
+def test_phase_trace_composition_tracks(clock):
+    tel = Telemetry("t")
+    scope = _scope(telemetry=tel)
+    scope.observe_step(1.0, {"env_wait": 0.75, "staging": 0.25})
+    trace = phase_trace({"p": summarize_stepscope(tel.snapshot())},
+                        pid_base=7)
+    bars = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in bars} == {"phase env_wait", "phase staging"}
+    assert all(e["pid"] == 8 for e in bars)
+    # Widths proportional to cumulative seconds, drawn back-to-back.
+    by_name = {e["name"]: e for e in bars}
+    assert by_name["phase env_wait"]["dur"] == 750_000
+    assert by_name["phase staging"]["ts"] == 750_000
+    json.dumps(trace)  # plain JSON, Perfetto-loadable
+
+
+def test_malicious_phase_names_bounded_by_cardinality_guard(clock):
+    from moolib_tpu.telemetry.registry import Registry
+
+    tel = Telemetry("t")
+    tel.registry = Registry(label_cardinality=8)
+    scope = _scope(telemetry=tel)
+    for i in range(50):
+        scope.observe_step(0.01, {f"phase{i}": 0.01})
+    phase_series = [sid for sid in tel.snapshot()
+                    if sid.startswith("stepscope_phase_seconds_total")]
+    # 8 admitted values + the overflow fold — never 50 series.
+    assert len(phase_series) <= 9
+    assert any('phase="other"' in sid for sid in phase_series)
+
+
+# -- acceptance: the seeded A2C cohort ----------------------------------------
+
+
+def _a2c_cfg(**overrides):
+    from moolib_tpu.examples.a2c import A2CConfig
+
+    base = dict(seed=0, total_steps=1200, log_interval_steps=600,
+                num_processes=2, batch_size=2, num_batches=2)
+    base.update(overrides)
+    return A2CConfig(**base)
+
+
+def _global_stepscope_summaries():
+    from moolib_tpu.telemetry import global_telemetry
+
+    return summarize_stepscope(global_telemetry().snapshot())
+
+
+def _exposed_comms_totals():
+    """(grad_allreduce+wire_wait seconds, wall seconds) for a2c_learner
+    from the process-global registry — cumulative, so acceptance runs
+    diff them (the registry outlives each train() call)."""
+    s = _global_stepscope_summaries().get("a2c_learner")
+    if s is None:
+        return 0.0, 0.0
+    comms = sum(secs for ph, secs in s["phases"].items()
+                if PHASE_CLASS.get(ph) == "comms")
+    return comms, s["wall_s"]
+
+
+@pytest.mark.integration
+def test_acceptance_a2c_cohort_fractions_everywhere():
+    """ISSUE 20 acceptance on the real cohort: ledgers close within 5%,
+    fractions in a live ``__telemetry`` scrape, in a flightrec bundle,
+    and as schema-valid trend rows."""
+    from moolib_tpu.bench.harness import parse_result
+    from moolib_tpu.examples.a2c import train
+    from moolib_tpu.flightrec.bundle import snapshot_bundle, validate_bundle
+    from moolib_tpu.rpc import Rpc
+    from moolib_tpu.telemetry import global_telemetry
+
+    comms0, wall0 = _exposed_comms_totals()
+    steps0 = _global_stepscope_summaries().get(
+        "a2c_learner", {}).get("steps", 0)
+
+    done = threading.Event()
+    logs = []
+
+    def run():
+        try:
+            logs.extend(train(_a2c_cfg(), log_fn=lambda s: None))
+        finally:
+            done.set()
+
+    trainer = threading.Thread(target=run, daemon=True)
+    trainer.start()
+    # LIVE scrape while the loops run: any Rpc's __telemetry merges the
+    # process-global registry, so the windowed fraction gauges must be
+    # visible over the wire mid-training.
+    server = Rpc("stepscope-live")
+    client = Rpc("stepscope-probe",
+                 telemetry=Telemetry("probe", enabled=False))
+    server.listen("127.0.0.1:0")
+    client.connect(server.debug_info()["listen"][0])
+    client.set_timeout(10.0)
+    live_gauges = {}
+    try:
+        deadline = time.monotonic() + 90.0
+        want = {f'{name}{{loop="a2c_learner"}}'
+                for name in FRACTION_GAUGES.values()}
+        while time.monotonic() < deadline and not done.is_set():
+            metrics = client.sync("stepscope-live", "__telemetry")["metrics"]
+            found = {sid: metrics[sid]["value"]
+                     for sid in want if sid in metrics}
+            if len(found) == len(want):
+                live_gauges = found
+                break
+            time.sleep(0.25)
+    finally:
+        client.close()
+        server.close()
+        trainer.join(timeout=180)
+    assert done.is_set(), "training did not finish"
+    assert logs, "training produced no logs"
+    assert set(live_gauges) == want, (
+        f"fractions missing from live scrape: got {sorted(live_gauges)}"
+    )
+    assert all(0.0 <= v <= 1.0 for v in live_gauges.values()), live_gauges
+
+    summaries = _global_stepscope_summaries()
+    learner = summaries["a2c_learner"]
+    assert learner["steps"] - steps0 > 0
+    # Ledger closure within 5% (cumulative: explicit + other vs wall).
+    for loop, s in summaries.items():
+        if s["steps"] == 0:
+            continue
+        err = abs(sum(s["phases"].values()) - s["wall_s"]) / s["wall_s"]
+        assert err <= 0.05, f"{loop}: ledger closure {err:.1%}"
+    # Envpool attribution rode along from the worker tier.
+    assert summaries["envpool"]["fractions"]["env_wait"] > 0.5
+
+    # Flightrec: the frozen bundle carries both the step_phases stamps
+    # and enough metrics to reconstruct the fractions after death.
+    bundle = validate_bundle(snapshot_bundle(
+        global_telemetry(), trigger="test", detail="stepscope acceptance"))
+    stamps = [e for e in bundle["events"] if e["kind"] == "step_phases"]
+    assert stamps, "no step_phases events in the bundle"
+    assert {e["fields"]["loop"] for e in stamps} >= {"a2c_learner"}
+    for e in stamps:
+        assert 0.0 <= e["fields"]["exposed_comms"] <= 1.0
+    recon = {}
+    for _src, snap in bundle["metrics"].items():
+        recon.update(summarize_stepscope(snap))
+    assert recon["a2c_learner"]["fractions"]["exposed_comms"] == \
+        pytest.approx(learner["fractions"]["exposed_comms"])
+
+    # Trend rows: schema-valid through the strict parser, loop-qualified.
+    rows = trend_rows(learner, smoke=True,
+                      cmd="python tools/stepscope_report.py --smoke")
+    for row in rows:
+        assert parse_result(dataclasses.asdict(row)) == row
+    assert {r.metric for r in rows} == {
+        "stepscope_a2c_learner_exposed_comms_fraction",
+        "stepscope_a2c_learner_host_blocked_fraction",
+        "stepscope_a2c_learner_env_wait_fraction",
+    }
+
+
+@pytest.mark.integration
+def test_acceptance_serialized_comms_strictly_higher_than_overlap():
+    """``overlap_comms=False`` puts the gradient reduction on the
+    critical path; exposed_comms_fraction is exactly the gauge that
+    tells the two modes apart — the serialized run must read strictly
+    higher. Computed as per-run deltas of the cumulative counters (the
+    process-global registry accretes across train() calls)."""
+    from moolib_tpu.examples.a2c import train
+
+    comms0, wall0 = _exposed_comms_totals()
+    train(_a2c_cfg(), log_fn=lambda s: None)
+    comms1, wall1 = _exposed_comms_totals()
+    train(_a2c_cfg(overlap_comms=False), log_fn=lambda s: None)
+    comms2, wall2 = _exposed_comms_totals()
+
+    overlap_frac = (comms1 - comms0) / (wall1 - wall0)
+    serial_frac = (comms2 - comms1) / (wall2 - wall1)
+    assert wall1 > wall0 and wall2 > wall1
+    assert serial_frac > overlap_frac, (
+        f"serialized exposed_comms {serial_frac:.4f} not above "
+        f"overlapped baseline {overlap_frac:.4f}"
+    )

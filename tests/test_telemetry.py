@@ -219,6 +219,65 @@ def test_registry_get_or_create_and_type_conflicts():
     assert math.isnan(r.snapshot()["live"]["value"])
 
 
+def test_label_cardinality_guard_folds_overflow_and_counts_folds():
+    r = Registry(label_cardinality=3)
+    for i in range(3):
+        r.counter("rpc_calls_total", endpoint=f"ep{i}").inc()
+    # The 4th distinct value folds into the reserved overflow series —
+    # one extra series per family, never an unbounded scrape.
+    folded = r.counter("rpc_calls_total", endpoint="ep3")
+    folded.inc()
+    assert r.counter("rpc_calls_total", endpoint="ep4") is folded
+    snap = r.snapshot()
+    assert 'rpc_calls_total{endpoint="other"}' in snap
+    assert 'rpc_calls_total{endpoint="ep3"}' not in snap
+    assert snap['rpc_calls_total{endpoint="other"}']["value"] == 1.0
+    # Every folded lookup is counted (self-exempt: the fold counter
+    # itself is unlabeled, so it can never recurse into the guard).
+    assert r.value("telemetry_label_overflow_total") == 2.0
+    # Admitted values keep resolving to their own series.
+    assert r.value("rpc_calls_total", endpoint="ep0") == 1.0
+
+
+def test_label_cardinality_reads_observe_but_never_consume_capacity():
+    r = Registry(label_cardinality=2)
+    # Reads/unregisters of unseen values must not claim family slots.
+    for i in range(10):
+        assert r.value("c_total", peer=f"probe{i}") is None
+        assert not r.unregister("c_total", peer=f"probe{i}")
+    r.counter("c_total", peer="a")
+    r.counter("c_total", peer="b")
+    assert set(r.snapshot()) == {'c_total{peer="a"}', 'c_total{peer="b"}'}
+    # Capacity is monotone: unregistering an admitted value does NOT
+    # return its slot, so a churn loop cannot defeat the guard.
+    assert r.unregister("c_total", peer="a")
+    r.counter("c_total", peer="c").inc()
+    assert 'c_total{peer="other"}' in r.snapshot()
+    # The overflow value itself is always addressable, cap or no cap.
+    r.counter("c_total", peer="other").inc()
+    assert r.value("c_total", peer="other") == 2.0
+
+
+def test_label_cardinality_guard_is_per_family_and_env_tunable(monkeypatch):
+    r = Registry(label_cardinality=2)
+    r.counter("a_total", peer="x")
+    r.counter("a_total", peer="y")
+    # Distinct label key on the same metric: its own family, own cap.
+    r.counter("a_total", endpoint="e0")
+    r.counter("a_total", endpoint="e1")
+    # Distinct metric name: own family too.
+    r.counter("b_total", peer="p0")
+    r.counter("b_total", peer="p1")
+    assert r.value("telemetry_label_overflow_total") is None
+    r.counter("a_total", peer="z")
+    assert r.value("telemetry_label_overflow_total") == 1.0
+    monkeypatch.setenv("MOOLIB_TPU_LABEL_CARDINALITY", "1")
+    env_r = Registry()
+    env_r.counter("c_total", peer="first")
+    env_r.counter("c_total", peer="second")
+    assert 'c_total{peer="other"}' in env_r.snapshot()
+
+
 def test_unregister_removes_series_and_allows_reregistration():
     r = Registry()
     r.counter("c_total", peer="a").inc(3)

@@ -67,6 +67,21 @@ env JAX_PLATFORMS=cpu python tools/telemetry_smoke.py
 timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/perf.py \
   --suite cpu-proxy --smoke --trends bench/trends.jsonl
 
+echo "== stepscope smoke =="
+# Step-phase attribution end to end (docs/observability.md, "Step-phase
+# attribution"): a short instrumented A2C cohort (real EnvPool workers,
+# the examples' learner loop under StepScope), asserting every loop's
+# phase ledger sums to its measured wall time within 5%, rendering the
+# per-peer + merged phase report (text + Chrome composition tracks),
+# and appending stepscope_<loop>_*_fraction rows to the same trend
+# artifact as the perf suite — gated by the same regression detector,
+# so a creeping exposed-comms share fails CI with a reproduce command
+# exactly like a throughput drop. The stepscope disabled-mode cost
+# rides the telemetry_smoke budget above (one fully disabled
+# instrumented step is charged per echo call).
+timeout -k 10 300 env JAX_PLATFORMS=cpu python tools/stepscope_report.py \
+  --smoke --trends bench/trends.jsonl
+
 echo "== hotwatch gate =="
 # hotlint's dynamic mirror (docs/analysis.md, "hotlint"): the Hotwatch
 # window contracts themselves (planted .item() caught with its site

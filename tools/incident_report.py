@@ -22,7 +22,13 @@ writes:
   Perfetto; merge metadata — offsets, ring-drop counts, causal
   adjustments — rides in ``otherData``);
 - ``report.json`` — peers reached/failed, per-peer offsets and RTTs,
-  record counts, and any on-disk bundles the peers had already captured.
+  record counts, any on-disk bundles the peers had already captured,
+  and per-peer step-phase attribution (``stepscope``): each bundle's
+  frozen ``metrics`` snapshot reconstructed into per-loop phase
+  summaries with the derived ``exposed_comms`` / ``host_blocked`` /
+  ``env_wait`` fractions (docs/observability.md), plus a deduplicated
+  cohort-wide merge — what the cohort was spending its steps on when
+  the incident fired.
 
 ``--bundles DIR`` merges already-written bundle files instead of
 crawling (the dead-cohort story: bundles pulled from shared disk); no
@@ -56,7 +62,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from moolib_tpu.rpc import Rpc  # noqa: E402
-from moolib_tpu.telemetry import Telemetry  # noqa: E402
+from moolib_tpu.telemetry import Telemetry, summarize_stepscope  # noqa: E402
+from moolib_tpu.telemetry.stepscope import merge_summaries  # noqa: E402
 from moolib_tpu.flightrec import (  # noqa: E402
     crawl_cohort,
     estimate_offset,
@@ -146,6 +153,17 @@ def write_report(out: str, bundles, offsets, rtts, captured, failed):
     write_timeline_jsonl(timeline, os.path.join(out, "timeline.jsonl"))
     with open(os.path.join(out, "trace.json"), "w") as f:
         json.dump(timeline_to_chrome(timeline, meta), f)
+    # Step-phase attribution survives the peer: each bundle's frozen
+    # metrics snapshot (one registry per telemetry source — the peer's
+    # own plus the merged process-global one) reconstructs into per-loop
+    # phase summaries, keyed <peer>/<source> so attribution stays
+    # traceable to the registry that recorded it.
+    stepscope = {}
+    for peer, b in bundles.items():
+        for src, snap in b["metrics"].items():
+            summaries = summarize_stepscope(snap)
+            if summaries:
+                stepscope[f"{peer}/{src}"] = summaries
     report = {
         "peers": sorted(bundles),
         "failed": [{"peer": p, "error": e} for p, e in failed],
@@ -158,6 +176,8 @@ def write_report(out: str, bundles, offsets, rtts, captured, failed):
         "spans": sum(1 for r in timeline if r["type"] == "span"),
         "bundles": bundle_paths,
         "peer_captured": captured,
+        "stepscope": stepscope,
+        "stepscope_merged": merge_summaries(stepscope),
     }
     with open(os.path.join(out, "report.json"), "w") as f:
         json.dump(report, f, indent=2, sort_keys=True)
@@ -180,6 +200,12 @@ def smoke() -> int:
         r.telemetry.set_tracing(True)
         r.set_timeout(5.0)
     b.define("echo", lambda x: x)
+    # A phase scope on one peer: the pulled bundles must carry enough to
+    # reconstruct step-phase attribution in report.json.
+    from moolib_tpu.telemetry import StepScope
+    scope = StepScope("smoke_loop", telemetry=a.telemetry)
+    for _ in range(8):
+        scope.observe_step(0.01, {"fwd_bwd": 0.007, "wire_wait": 0.002})
     # Both peers listen: only peers with a dialable address are
     # advertised to the crawler (connect-only lurkers are unreachable).
     a.listen("127.0.0.1:0")
@@ -210,6 +236,13 @@ def smoke() -> int:
                 )
                 report = write_report(out, bundles, offsets, rtts,
                                       captured, failed)
+                ss = [s for s in report["stepscope"].values()
+                      if "smoke_loop" in s]
+                assert ss and ss[0]["smoke_loop"]["steps"] == 8, (
+                    f"stepscope attribution missing: {report['stepscope']}"
+                )
+                merged_ss = report["stepscope_merged"]["smoke_loop"]
+                assert merged_ss["fractions"]["exposed_comms"] > 0.1, merged_ss
                 # Re-load what we wrote: the strict parser must accept it.
                 for path in report["bundles"].values():
                     load_bundle(path)
@@ -218,6 +251,7 @@ def smoke() -> int:
         finally:
             scraper.close()
     finally:
+        scope.close()
         a.close()
         b.close()
     assert timeline, "merged timeline is empty"

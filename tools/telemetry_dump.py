@@ -18,7 +18,10 @@ every peer it can see, and writes
   process each merge the process-global buffer into their export;
   identical events are deduplicated here so shared tracks appear once.
   Per-peer span-ring eviction counts are carried through into the merged
-  export's ``otherData`` so a truncated timeline is labeled;
+  export's ``otherData`` so a truncated timeline is labeled. Peers with
+  ``stepscope_*`` series additionally get a ``stepscope <peer>``
+  composition track — per-loop phase bars reconstructed from the
+  metrics snapshot (where step time went; the span tracks carry when);
 - ``bundles/incident_<peer>_<ts>.json`` — with ``--bundle``, each
   peer's ``__flightrec`` snapshot written in the incident-bundle format
   (the SAME versioned, strictly-validated schema
@@ -54,7 +57,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from moolib_tpu.rpc import Rpc  # noqa: E402
-from moolib_tpu.telemetry import Telemetry, parse_prometheus  # noqa: E402
+from moolib_tpu.telemetry import (  # noqa: E402
+    Telemetry,
+    parse_prometheus,
+    summarize_stepscope,
+)
+from moolib_tpu.telemetry.stepscope import phase_trace  # noqa: E402
 from moolib_tpu.flightrec import (  # noqa: E402
     crawl_cohort,
     validate_bundle,
@@ -209,6 +217,20 @@ def main(argv=None):
                       for peer, (snap, _p, _b) in results.items()
                       if "trace" in snap]
             merged = merge_chrome_traces(traces)
+            # Step-phase composition tracks ride the same merged file:
+            # per-loop phase bars reconstructed from each peer's
+            # stepscope series (pids offset past the span tracks).
+            stepscope = {
+                peer: s for peer, s in (
+                    (p, summarize_stepscope(m)) for p, m in metrics.items()
+                ) if s
+            }
+            if stepscope:
+                pid_base = max(
+                    (e["pid"] for e in merged["traceEvents"]), default=0
+                )
+                comp = phase_trace(stepscope, pid_base=pid_base)
+                merged["traceEvents"].extend(comp["traceEvents"])
             with open(os.path.join(args.out, "trace.json"), "w") as f:
                 json.dump(merged, f)
             n = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")

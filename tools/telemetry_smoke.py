@@ -51,6 +51,12 @@ GATES_PER_CALL = 32
 # but the budget charges a generous per-call multiple of the gate anyway
 # so the disabled-mode guarantee covers pathological paths too.
 FLIGHT_GATES_PER_CALL = 8
+# Phase regions per charged stepscope step. The budget bills one fully
+# disabled ``scope.step()`` containing this many ``scope.phase()``
+# context managers per echo call — generous: no instrumented hot loop
+# wraps more than ~6 phases per step (docs/observability.md), and a
+# real step does far more work than a loopback echo.
+STEPSCOPE_PHASES_PER_CALL = 8
 
 
 def _echo_cohort(tracing: bool):
@@ -179,6 +185,42 @@ def measure_flight_gate_ns(iters: int = 200_000) -> float:
     return _measure_gate_ns(fr, iters)
 
 
+def measure_stepscope_step_ns(iters: int = 20_000) -> float:
+    """One fully disabled stepscope step — ``scope.step()`` wrapping
+    :data:`STEPSCOPE_PHASES_PER_CALL` phase regions — in seconds.
+
+    Unlike the bare gates above, the disabled cost here is the whole
+    context-manager machinery (``__enter__``/``__exit__`` dispatch plus
+    the one-attribute ``_active`` branch inside each), because that is
+    exactly what rides an instrumented loop when telemetry is off."""
+    from moolib_tpu.telemetry import StepScope
+
+    scope = StepScope("gatebench", telemetry=Telemetry("gatebench",
+                                                       enabled=False))
+    phases = [scope.phase(f"p{i}") for i in range(STEPSCOPE_PHASES_PER_CALL)]
+
+    def loop_instrumented(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with scope.step():
+                for cm in phases:
+                    with cm:
+                        pass
+        return time.perf_counter() - t0
+
+    def loop_bare(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            for cm in phases:
+                pass
+        return time.perf_counter() - t0
+
+    instrumented = min(loop_instrumented(iters) for _ in range(3))
+    bare = min(loop_bare(iters) for _ in range(3))
+    scope.close()
+    return max(0.0, (instrumented - bare) / iters)
+
+
 def check_flightrec_disabled_cleanliness(calls: int = 20) -> None:
     """With the recorder gated off, an echo cohort's rings must stay
     EMPTY through live traffic (the disabled mode is silence, not merely
@@ -230,14 +272,18 @@ def main(argv=None):
     per_call_off = measure_disabled_echo(args.calls)
     gate = measure_gate_ns()
     fgate = measure_flight_gate_ns()
-    # One budget for BOTH gate families: the telemetry gates plus the
-    # flight-recorder gates must together stay under the echo-latency
-    # fraction (docs/observability.md, docs/incidents.md).
-    overhead = GATES_PER_CALL * gate + FLIGHT_GATES_PER_CALL * fgate
+    sstep = measure_stepscope_step_ns()
+    # One budget for ALL gate families: the telemetry gates, the
+    # flight-recorder gates, and one fully disabled stepscope step must
+    # together stay under the echo-latency fraction
+    # (docs/observability.md, docs/incidents.md).
+    overhead = GATES_PER_CALL * gate + FLIGHT_GATES_PER_CALL * fgate + sstep
     frac = overhead / per_call_off
     print(f"echo {per_call_off * 1e6:.0f}us/call (telemetry OFF); "
           f"gate {gate * 1e9:.1f}ns x{GATES_PER_CALL} + "
-          f"flight gate {fgate * 1e9:.1f}ns x{FLIGHT_GATES_PER_CALL} = "
+          f"flight gate {fgate * 1e9:.1f}ns x{FLIGHT_GATES_PER_CALL} + "
+          f"stepscope step {sstep * 1e9:.0f}ns "
+          f"(x{STEPSCOPE_PHASES_PER_CALL} phases) = "
           f"{overhead * 1e6:.3f}us/call -> {frac * 100:.3f}% "
           f"(budget {args.budget * 100:.0f}%)")
     assert frac < args.budget, (
